@@ -1,0 +1,8 @@
+from .abstract_accelerator import Accelerator  # noqa: F401
+from .real_accelerator import (  # noqa: F401
+    CpuAccelerator,
+    GpuAccelerator,
+    TpuAccelerator,
+    get_accelerator,
+    set_accelerator,
+)

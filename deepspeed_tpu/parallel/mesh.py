@@ -1,0 +1,199 @@
+"""Device-mesh construction and the global "process group" registry.
+
+This is the TPU-native replacement for the reference's process-group machinery
+(``deepspeed/utils/groups.py:46 initialize``, expert-group creation at
+groups.py:108/202, world-group clone at :304, and ``PipelineParallelGrid`` at
+``deepspeed/runtime/pipe/topology.py:251``). Instead of NCCL communicators,
+every parallel axis is a named axis of one global ``jax.sharding.Mesh``;
+"creating a group" is picking an axis (or tuple of axes) name.
+
+Axis layout (major → minor): ``pipe, data, expert, seq, model``.
+
+  - ``data``    — ZeRO/data parallelism. Non-expert parameters/grads/optimizer
+                  state shard over ("data", "expert", "seq") combined (expert
+                  and seq are size-1 unless enabled, so this degenerates to
+                  pure DP).
+  - ``expert``  — expert parallelism: a factor of the DP world carved out for
+                  MoE all-to-all, mirroring _get_expert_parallel_ranks
+                  (groups.py:156) where EP groups are sub-groups of DP.
+  - ``seq``     — sequence/context parallelism (ring attention / Ulysses) —
+                  beyond-parity axis, size 1 by default.
+  - ``model``   — tensor (Megatron-style) model parallelism, innermost so its
+                  collectives ride adjacent ICI links.
+  - ``pipe``    — pipeline stages, outermost (cross-slice/DCN friendly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+# Axes over which ZeRO (sharded-DP) state is partitioned. `expert` and `seq`
+# multiply into the effective DP world when enabled.
+ZERO_AXES = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+# Axes over which the global batch is split.
+BATCH_AXES = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Degrees of parallelism; -1 for data means "fill remaining devices"."""
+
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    expert: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        fixed = self.model * self.pipe * self.expert * self.seq
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"device count {n_devices} not divisible by model×pipe×expert×seq = {fixed}")
+        data = self.data
+        if data == -1:
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}×{fixed} (dp×rest) != device count {n_devices}")
+        return MeshConfig(data=data, model=self.model, pipe=self.pipe, expert=self.expert,
+                          seq=self.seq)
+
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return (self.pipe, self.data, self.expert, self.seq, self.model)
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence] = None,
+               *,
+               data: int = -1,
+               model: int = 1,
+               pipe: int = 1,
+               expert: int = 1,
+               seq: int = 1):
+    """Build the global ``jax.sharding.Mesh``.
+
+    Uses ``jax.experimental.mesh_utils.create_device_mesh`` when possible so
+    the logical axes map onto the physical ICI torus well.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if config is None:
+        config = MeshConfig(data=data, model=model, pipe=pipe, expert=expert, seq=seq)
+    if devices is None:
+        devices = jax.devices()
+    config = config.resolve(len(devices))
+
+    try:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_device_mesh(config.dims, devices=list(devices))
+    except Exception:  # non-TPU platforms (CPU test meshes) lack torus metadata
+        device_array = np.asarray(list(devices)).reshape(config.dims)
+    return Mesh(device_array, MESH_AXES)
+
+
+class _GroupsState:
+    """Global registry, the analog of the reference's module-level group dict
+    in ``deepspeed/utils/groups.py``."""
+
+    def __init__(self):
+        self.mesh = None
+        self.mesh_config: Optional[MeshConfig] = None
+        self.topology: Optional["ProcessTopology"] = None
+
+
+_state = _GroupsState()
+
+
+def initialize_mesh(config: Optional[MeshConfig] = None, devices=None, **kwargs):
+    """Create and install the global mesh (≅ ``groups.initialize``,
+    reference utils/groups.py:46)."""
+    mesh = build_mesh(config, devices, **kwargs)
+    set_mesh(mesh)
+    logger.info(f"initialized global mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    return mesh
+
+
+def set_mesh(mesh) -> None:
+    from .topology import ProcessTopology
+
+    _state.mesh = mesh
+    dims = dict(zip(mesh.axis_names, mesh.devices.shape))
+    _state.mesh_config = MeshConfig(
+        data=dims.get(DATA_AXIS, 1),
+        model=dims.get(MODEL_AXIS, 1),
+        pipe=dims.get(PIPE_AXIS, 1),
+        expert=dims.get(EXPERT_AXIS, 1),
+        seq=dims.get(SEQ_AXIS, 1),
+    )
+    _state.topology = ProcessTopology(list(mesh.axis_names), list(mesh.devices.shape))
+
+
+def get_mesh():
+    if _state.mesh is None:
+        initialize_mesh()
+    return _state.mesh
+
+
+def has_mesh() -> bool:
+    return _state.mesh is not None
+
+
+def get_topology():
+    get_mesh()
+    return _state.topology
+
+
+def reset_mesh() -> None:
+    _state.mesh = None
+    _state.mesh_config = None
+    _state.topology = None
+
+
+def _axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+
+
+# --- world-size accessors, mirroring deepspeed/utils/groups.py getters ---
+def get_data_parallel_world_size() -> int:
+    # "data parallel" in the ZeRO sense = every axis ZeRO state shards over.
+    return math.prod(_axis_size(a) for a in ZERO_AXES)
+
+
+def get_model_parallel_world_size() -> int:
+    return _axis_size(MODEL_AXIS)
+
+
+def get_pipe_parallel_world_size() -> int:
+    return _axis_size(PIPE_AXIS)
+
+
+def get_expert_parallel_world_size() -> int:
+    return _axis_size(EXPERT_AXIS)
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _axis_size(SEQ_AXIS)
+
+
+def get_world_size() -> int:
+    mesh = get_mesh()
+    return mesh.devices.size

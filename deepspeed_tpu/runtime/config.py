@@ -1,0 +1,306 @@
+"""Master JSON config (≅ reference ``deepspeed/runtime/config.py``).
+
+Parses the DeepSpeed JSON surface — unmodified user configs must parse — into
+a typed tree, enforcing the central batch invariant
+``train_batch_size = micro_batch_per_gpu × gradient_accumulation_steps × dp_world_size``
+(reference runtime/config.py batch reconciliation), plus TPU-specific
+extensions under the ``"mesh"`` key (tp/pp/ep/sp degrees).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from ..utils.logging import logger
+from .config_utils import DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys
+from .zero.config import DeepSpeedZeroConfig
+
+# ---------------------------------------------------------------------------
+# Sub-configs
+# ---------------------------------------------------------------------------
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """``fp16`` block (reference runtime/fp16 + constants.py)."""
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    # TPU-native: keep fp32 master weights in optimizer state (ZeRO-1 style)
+    bf16_master_weights: bool = True
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "Adam"
+    params: Dict[str, Any] = Field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """``activation_checkpointing`` block (reference checkpointing.py:789)."""
+
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class MonitorConfig(DeepSpeedConfigModel):
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self) -> bool:
+        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: Union[int, str] = "auto"
+    partition: str = "best"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+
+
+class MeshDims(DeepSpeedConfigModel):
+    """TPU extension: degrees of parallelism for the global device mesh."""
+
+    data: int = -1  # -1 = fill remaining devices
+    model: int = 1
+    pipe: int = 1
+    expert: int = 1
+    seq: int = 1
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class AioConfig(DeepSpeedConfigModel):
+    """``aio`` block (reference csrc/aio + op_builder/async_io.py)."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class CurriculumParams(DeepSpeedConfigModel):
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Master config
+# ---------------------------------------------------------------------------
+
+
+class DeepSpeedConfig(DeepSpeedConfigModel):
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_clipping: float = 0.0
+    sparse_gradients: bool = False
+
+    zero_optimization: DeepSpeedZeroConfig = Field(default_factory=DeepSpeedZeroConfig)
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+
+    tensorboard: Optional[TensorBoardConfig] = None  # legacy top-level (deprecated)
+    monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    mesh: MeshDims = Field(default_factory=MeshDims)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
+    aio: AioConfig = Field(default_factory=AioConfig)
+    curriculum_learning: CurriculumParams = Field(default_factory=CurriculumParams)
+    eigenvalue: EigenvalueConfig = Field(default_factory=EigenvalueConfig)
+
+    zero_allow_untested_optimizer: bool = False
+    zero_force_ds_cpu_optimizer: bool = True
+    communication_data_type: Optional[str] = None
+    seed: int = 1234
+    disable_allgather: bool = False
+
+    # populated by reconciliation
+    _world_size: int = 1
+
+    def __init__(self, config: Union[str, Dict, None] = None, mpu=None, world_size: int = 1,
+                 **kwargs):
+        if config is None:
+            data = dict(kwargs)
+        elif isinstance(config, str):
+            with open(config, "r") as fh:
+                data = json.load(fh, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            data = dict(config)
+        else:
+            raise ValueError(f"Expected a path or dict config, got {type(config)}")
+
+        # legacy top-level monitor keys fold into monitor_config
+        monitor = data.setdefault("monitor_config", {})
+        for legacy in ("tensorboard", "wandb", "csv_monitor"):
+            if legacy in data and legacy not in monitor:
+                monitor[legacy] = data[legacy]
+
+        super().__init__(**data)
+        object.__setattr__(self, "_world_size", world_size)
+        self._do_batch_reconciliation(world_size)
+        self._do_sanity_check()
+
+    # --- batch invariant -------------------------------------------------
+    def _do_batch_reconciliation(self, world_size: int) -> None:
+        """train_batch = micro_batch × gas × dp_world (reference semantics:
+        any two determine the third; one alone fills defaults)."""
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * world_size)
+        elif train is not None and gas is not None:
+            micro = train // (gas * world_size)
+        elif micro is not None and gas is not None:
+            train = micro * gas * world_size
+        elif train is not None:
+            gas = 1
+            micro = train // world_size
+        elif micro is not None:
+            gas = 1
+            train = micro * world_size
+        else:
+            raise ValueError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs "
+                "to be provided")
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+        if train != micro * gas * world_size:
+            raise ValueError(
+                f"Check batch related parameters. train_batch_size is not equal to "
+                f"micro_batch_per_gpu * gradient_acc_step * world_size: "
+                f"{train} != {micro} * {gas} * {world_size}")
+
+    def _do_sanity_check(self) -> None:
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 modes cannot be enabled simultaneously")
+        if self.zero_optimization.stage > 0 and not (self.fp16.enabled or self.bf16.enabled):
+            logger.warning("ZeRO enabled with full fp32 precision — consider bf16 on TPU")
+
+    # --- convenience accessors (mirror engine property style) -----------
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_optimization.stage > 0
+
+    @property
+    def precision_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def print_config(self, name: str = "DeepSpeedConfig") -> None:
+        logger.info(f"{name}:\n{json.dumps(self.model_dump(mode='json'), indent=2, default=str)}")

@@ -1,0 +1,85 @@
+"""ZeRO configuration (≅ reference ``runtime/zero/config.py:76``).
+
+The knobs keep the reference's JSON names so unmodified user configs parse.
+On TPU many of them steer the GSPMD sharding policy / block schedule instead
+of eager bucketing:
+
+* ``stage``                       → which state pytrees shard over the data axis
+* ``reduce_bucket_size``          → grad reduce-scatter flat-buffer chunking
+* ``stage3_prefetch_bucket_size`` / ``stage3_max_live_parameters`` /
+  ``stage3_max_reuse_distance``   → static memory budget of the per-block
+                                     allgather schedule (reference's trace-based
+                                     prefetcher becomes a compile-time schedule)
+* ``sub_group_size``              → optimizer-step tiling for offload
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Optional
+
+from pydantic import Field
+
+from ..config_utils import DeepSpeedConfigModel, pp_int
+from .offload_config import (
+    DeepSpeedZeroOffloadOptimizerConfig,
+    DeepSpeedZeroOffloadParamConfig,
+    OffloadDeviceEnum,
+)
+
+
+class ZeroStageEnum(IntEnum):
+    """≅ reference runtime/zero/config.py:67."""
+
+    disabled = 0
+    optimizer_states = 1
+    gradients = 2
+    weights = 3
+    max_stage = 3
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    stage: ZeroStageEnum = ZeroStageEnum.disabled
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(int(5e8), ge=0)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None  # default True for stage 3 (set below)
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+
+    # offload
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+
+    # stage-3 knobs
+    sub_group_size: int = Field(int(1e9), ge=0)
+    stage3_max_live_parameters: int = Field(int(1e9), ge=0)
+    stage3_max_reuse_distance: int = Field(int(1e9), ge=0)
+    stage3_prefetch_bucket_size: int = Field(int(5e7), ge=0)
+    stage3_param_persistence_threshold: int = Field(int(1e5), ge=0)
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    stage3_gather_fp16_weights_on_model_save: bool = Field(
+        False, json_schema_extra={
+            "deprecated": True,
+            "new_param": "stage3_gather_16bit_weights_on_model_save"})
+
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+    # MiCS-style hierarchical sharding: shard ZeRO state over a sub-group of
+    # the data axis, replicate across the rest (reference runtime/zero/mics.py)
+    mics_shard_size: int = Field(-1, alias="mics_shard_size")
+    mics_hierarchical_params_gather: bool = False
+    memory_efficient_linear: bool = True
+
+    def model_post_init(self, __context) -> None:
+        if self.overlap_comm is None:
+            self.overlap_comm = self.stage == ZeroStageEnum.weights
+
+    def __repr__(self):
+        return (f"DeepSpeedZeroConfig(stage={int(self.stage)}, "
+                f"reduce_bucket_size={pp_int(self.reduce_bucket_size)}, "
+                f"offload_param={self.offload_param}, "
+                f"offload_optimizer={self.offload_optimizer})")

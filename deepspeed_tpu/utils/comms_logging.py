@@ -1,0 +1,120 @@
+"""Per-op communication latency/bandwidth records.
+
+Capability parity with reference ``deepspeed/utils/comms_logging.py`` —
+``CommsLogger`` (:61) and the algorithmic/bus bandwidth math (:28). Bandwidth
+formulas are the standard collective-cost model: for an all-reduce over n
+ranks, bus bytes = 2·(n-1)/n · size, etc.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List
+
+from .logging import log_dist
+
+
+def get_caller_func(frame_depth: int = 3) -> str:
+    import sys
+
+    return sys._getframe(frame_depth).f_code.co_name
+
+
+def convert_size(size_bytes: float) -> str:
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return f"{s} {size_name[i]}"
+
+
+def calc_bw_log(comm_op: str, size: int, duration: float, n: int) -> tuple:
+    """(algbw, busbw) in Gbps. ``n`` = ranks participating."""
+    duration = max(duration, 1e-9)
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n) if n > 1 else size / duration
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor", "all_gather_object"):
+        size *= n
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n) if n > 1 else size / duration
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        tput = size * 2 / duration
+        busbw = (size / duration) * (2 * (n - 1) / n) if n > 1 else size / duration
+    else:  # send/recv/broadcast/reduce/barrier
+        tput = size / duration
+        busbw = tput
+    # bytes/sec → Gbps
+    return tput * 8 / 1e9, busbw * 8 / 1e9
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, prof_all: bool = True, prof_ops=None,
+                 verbose: bool = False, debug: bool = False):
+        self.enabled = enabled
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.verbose = verbose
+        self.debug = debug
+        self.comms_dict: Dict[str, Dict[int, List]] = defaultdict(dict)
+
+    def configure(self, comms_config) -> None:
+        self.enabled = comms_config.comms_logger_enabled
+        if self.enabled:
+            self.verbose = comms_config.comms_logger.verbose
+            self.debug = comms_config.comms_logger.debug
+            self.prof_ops = comms_config.comms_logger.prof_ops
+            self.prof_all = comms_config.comms_logger.prof_all
+
+    def start_profiling_comms(self):
+        self.enabled = True
+
+    def stop_profiling_comms(self):
+        self.enabled = False
+
+    def append(self, raw_name: str, record_name: str, latency: float, msg_size: int,
+               n_ranks: int) -> None:
+        algbw, busbw = calc_bw_log(raw_name, msg_size, latency, n_ranks)
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                self.comms_dict[record_name][msg_size][0] += 1
+                self.comms_dict[record_name][msg_size][1].append(latency)
+                self.comms_dict[record_name][msg_size][2].append(algbw)
+                self.comms_dict[record_name][msg_size][3].append(busbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+        else:
+            self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+        if self.verbose:
+            log_dist(
+                f"comm op: {record_name} | time (ms): {latency * 1e3:.2f} | "
+                f"msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw:.2f} | "
+                f"busbw (Gbps): {busbw:.2f}", [0])
+
+    def log_all(self, print_log: bool = True, show_straggler: bool = False):
+        from copy import deepcopy
+
+        lines = [f"{'Comm. Op': <20}{'Message Size': <20}{'Count': <20}"
+                 f"{'Total Latency(ms)': <20}{'Avg Latency(ms)': <20}"
+                 f"{'tput_avg (Gbps)': <20}{'busbw_avg (Gbps)': <20}"]
+        out = deepcopy(self.comms_dict)
+        for record_name, entries in out.items():
+            lines.append(record_name)
+            for msg_size, vals in sorted(entries.items()):
+                count, latencies, algbws, busbws = vals
+                total_lat = sum(latencies)
+                avg_lat = total_lat / count
+                avg_algbw = sum(algbws) / count
+                avg_busbw = sum(busbws) / count
+                lines.append(
+                    f"{' ': <20}{convert_size(msg_size): <20}{count: <20}"
+                    f"{total_lat * 1e3: <20.2f}{avg_lat * 1e3: <20.2f}"
+                    f"{avg_algbw: <20.2f}{avg_busbw: <20.2f}")
+        summary = "\n".join(lines)
+        if print_log:
+            log_dist("\n" + summary, [0])
+        return out

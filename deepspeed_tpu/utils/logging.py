@@ -1,0 +1,79 @@
+"""Rank-aware logging.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (logger
+factory at utils/logging.py:20, ``log_dist`` rank-filtered logging at
+utils/logging.py:75), re-expressed for a JAX multi-process world where the
+process index comes from ``jax.process_index()`` rather than torch.distributed.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVEL_DEFAULT = logging.INFO
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "DeepSpeedTPU", level: int = LOG_LEVEL_DEFAULT) -> logging.Logger:
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), LOG_LEVEL_DEFAULT))
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("RANK", "0"))
+
+
+@functools.lru_cache(None)
+def warning_once(msg: str):
+    logger.warning(msg)
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the listed process ranks (``[-1]`` or None = all).
+
+    Mirrors the semantics of reference ``log_dist`` (utils/logging.py:75) with
+    JAX process indices standing in for torch.distributed ranks.
+    """
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else []
+    should_log = not ranks or (-1 in ranks) or (my_rank in ranks)
+    if should_log:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        print(message, flush=True)

@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+The analog of the reference's ``tests/conftest.py`` + ``tests/unit/common.py``
+device gating: unit tests run on a **virtual 8-device CPU mesh**
+(``--xla_force_host_platform_device_count=8``) so the full suite runs without
+TPUs — the same motivation as the reference's CPU CI lanes. The axon/TPU
+plugin (when present) force-selects itself via ``jax.config``; we force the
+platform back to cpu *before* any backend is initialized.
+"""
+
+import os
+
+# Must happen before the first JAX backend initialization.
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " " + _flag
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Each test gets a fresh global mesh registry."""
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+    mesh_mod.reset_mesh()
+
+
+@pytest.fixture
+def eight_device_mesh():
+    from deepspeed_tpu.parallel import initialize_mesh
+
+    return initialize_mesh()

@@ -1,0 +1,104 @@
+"""Config-system tests (analog of reference tests/unit/runtime/
+test_ds_config_dict.py and test_ds_config_model.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.zero.config import ZeroStageEnum
+from deepspeed_tpu.runtime.zero.offload_config import OffloadDeviceEnum
+
+
+def test_batch_reconciliation_all_given():
+    c = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+                         "gradient_accumulation_steps": 1}, world_size=8)
+    assert c.train_batch_size == 32
+
+
+def test_batch_reconciliation_infer_gas():
+    c = DeepSpeedConfig({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4},
+                        world_size=8)
+    assert c.gradient_accumulation_steps == 2
+
+
+def test_batch_reconciliation_infer_train():
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4,
+                         "gradient_accumulation_steps": 2}, world_size=4)
+    assert c.train_batch_size == 32
+
+
+def test_batch_reconciliation_micro_only():
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2}, world_size=8)
+    assert c.train_batch_size == 16
+    assert c.gradient_accumulation_steps == 1
+
+
+def test_batch_invariant_violation():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4,
+                         "gradient_accumulation_steps": 1}, world_size=8)
+
+
+def test_no_batch_size_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_zero_config_parse():
+    c = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "reduce_bucket_size": 1000,
+            "offload_optimizer": {"device": "cpu"},
+            "stage3_prefetch_bucket_size": 500,
+        },
+    }, world_size=8)
+    assert c.zero_optimization.stage == ZeroStageEnum.weights
+    assert c.zero_optimization.offload_optimizer.device == OffloadDeviceEnum.cpu
+    assert c.zero_optimization.reduce_bucket_size == 1000
+    # stage-3 defaults overlap_comm on
+    assert c.zero_optimization.overlap_comm is True
+
+
+def test_deprecated_field_migration():
+    c = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {"stage3_gather_fp16_weights_on_model_save": True},
+    }, world_size=8)
+    assert c.zero_optimization.stage3_gather_16bit_weights_on_model_save is True
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=8)
+
+
+def test_auto_values_dropped():
+    c = DeepSpeedConfig({"train_batch_size": 8, "gradient_clipping": "auto"}, world_size=8)
+    assert c.gradient_clipping == 0.0
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=8)
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"train_batch_size": 16, "fp16": {"enabled": True}}))
+    c = DeepSpeedConfig(str(p), world_size=8)
+    assert c.fp16.enabled and c.train_batch_size == 16
+
+
+def test_legacy_monitor_keys_fold_in():
+    c = DeepSpeedConfig({"train_batch_size": 8,
+                         "tensorboard": {"enabled": True, "output_path": "/tmp/tb"}},
+                        world_size=8)
+    assert c.monitor_config.tensorboard.enabled
+    assert c.monitor_config.enabled

@@ -1,0 +1,68 @@
+"""Topology/mesh unit tests (analog of reference tests for
+runtime/pipe/topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel import (
+    MeshConfig,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+    get_data_parallel_world_size,
+    get_model_parallel_world_size,
+    initialize_mesh,
+)
+
+
+def test_topology_rank_coord_roundtrip():
+    topo = ProcessTopology(["pipe", "data", "model"], [2, 2, 2])
+    assert topo.world_size() == 8
+    for rank in range(8):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(pipe=coord.pipe, data=coord.data, model=coord.model) == rank
+
+
+def test_topology_axis_comm_lists():
+    topo = ProcessTopology(["pipe", "data"], [2, 4])
+    data_lists = topo.get_axis_comm_lists("data")
+    assert data_lists == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert pipe_lists == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.filter_match(pipe=0) == [0, 1, 2, 3]
+    assert topo.filter_match(pipe=1, model=1) == [5, 7]
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    # data axis omitted by default, like reference checkpoint naming
+    assert topo.get_rank_repr(0) == "pipe_00-model_00"
+    assert topo.get_rank_repr(3) == "pipe_01-model_01"
+
+
+def test_pipe_data_topology():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    assert topo.get_dim("pipe") == 2
+    assert topo.get_dim("data") == 4
+
+
+def test_mesh_config_resolve():
+    cfg = MeshConfig(model=2).resolve(8)
+    assert cfg.data == 4
+    with pytest.raises(ValueError):
+        MeshConfig(model=3).resolve(8)
+
+
+def test_initialize_mesh_dp_world():
+    initialize_mesh(model=2)
+    assert get_data_parallel_world_size() == 4
+    assert get_model_parallel_world_size() == 2
+
+
+def test_initialize_mesh_default_all_data():
+    mesh = initialize_mesh()
+    assert get_data_parallel_world_size() == 8
+    assert dict(zip(mesh.axis_names, mesh.devices.shape))["data"] == 8
