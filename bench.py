@@ -1,0 +1,87 @@
+"""Benchmark: GPT-2 125M causal-LM training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+
+``vs_baseline`` compares achieved model TFLOPS against the reference's
+headline single-device number: 64 TFLOPS/GPU for BERT-Large pretraining with
+DeepSpeed's fused kernels on V100-32GB (BASELINE.md row 1,
+reference docs/_tutorials/bert-pretraining.md:392).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+    from deepspeed_tpu.runtime.utils import count_parameters
+
+    SEQ = 1024
+    MICRO_BS = 8
+
+    cfg = gpt2_config("gpt2-125m", n_positions=SEQ, dtype=jnp.bfloat16)
+    model = GPT2LMHeadModel(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": MICRO_BS,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "Adam", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size, (engine.train_batch_size(), SEQ)).astype(np.int32)}
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = engine.train_batch(batch=make_batch())
+    jax.block_until_ready(loss)
+
+    steps = 10
+    batches = [make_batch() for _ in range(steps)]
+    t0 = time.perf_counter()
+    for b in batches:
+        loss = engine.train_batch(batch=b)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    tokens_per_step = engine.train_batch_size() * SEQ
+    tokens_per_sec_chip = tokens_per_step * steps / dt / n_chips
+
+    # model flops per token: fwd+bwd ≈ 6N dense + attention term
+    n_params = count_parameters(engine.state["params"])
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * SEQ
+    achieved_tflops = tokens_per_sec_chip * flops_per_token / 1e12
+
+    print(json.dumps({
+        "metric": "GPT-2 125M seq1024 bf16 ZeRO-1 training throughput",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(achieved_tflops / 64.0, 3),
+        "detail": {
+            "achieved_model_tflops_per_chip": round(achieved_tflops, 2),
+            "baseline": "DeepSpeed BERT-Large 64 TFLOPS on 1xV100-32GB",
+            "n_chips": n_chips,
+            "loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

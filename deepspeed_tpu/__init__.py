@@ -1,0 +1,98 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with the
+capabilities of DeepSpeed (reference v0.9.5), built on JAX/XLA/pjit/Pallas.
+
+Public API parity with ``deepspeed/__init__.py``: :func:`initialize` (:58),
+:func:`init_inference` (:260), :func:`add_config_arguments` (:237), plus the
+``comm``/``zero``/``monitor``/``ops`` subpackages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from . import parallel  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .runtime.engine import DeepSpeedEngine  # noqa: F401
+from .utils.logging import log_dist, logger  # noqa: F401
+
+
+def initialize(args=None,
+               model: Any = None,
+               optimizer=None,
+               model_parameters: Any = None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config: Union[str, Dict, None] = None,
+               config_params: Union[str, Dict, None] = None,
+               loss_fn=None,
+               sharding_rules=None,
+               mesh=None):
+    """Build the engine (≅ reference ``deepspeed.initialize``,
+    deepspeed/__init__.py:58).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+
+    TPU-native notes: ``model`` is a flax Module (``__call__(batch) -> loss``)
+    or a pure ``loss_fn(params, batch, rng)``; ``optimizer`` comes from the
+    JSON config (``optimizer.type``); ``mpu`` is superseded by the mesh —
+    pass ``mesh`` or config["mesh"] degrees instead.
+    """
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    if config is None:
+        raise ValueError("DeepSpeed requires --deepspeed_config or config=")
+
+    # PipelineModule → PipelineEngine dispatch (reference __init__.py:151-189)
+    try:
+        from .runtime.pipe.module import PipelineModule
+    except ImportError:
+        PipelineModule = None
+
+    if PipelineModule is not None and isinstance(model, PipelineModule):
+        from .runtime.pipe.engine import PipelineEngine
+
+        engine = PipelineEngine(model=model, config=config,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler, collate_fn=collate_fn,
+                                mesh=mesh)
+    else:
+        engine = DeepSpeedEngine(model=model, loss_fn=loss_fn,
+                                 model_parameters=model_parameters,
+                                 config=config, sharding_rules=sharding_rules,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler, collate_fn=collate_fn,
+                                 mesh=mesh)
+    return engine, engine.optimizer_def, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model: Any = None, config: Union[str, Dict, None] = None, **kwargs):
+    """Build the inference engine (≅ reference ``deepspeed.init_inference``,
+    deepspeed/__init__.py:260)."""
+    from .inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Inject --deepspeed / --deepspeed_config CLI args (≅ reference
+    deepspeed/__init__.py:237)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag, parity only)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    return parser

@@ -1,0 +1,130 @@
+"""Experiment monitors.
+
+Capability parity with reference ``deepspeed/monitor/monitor.py`` — ``Monitor``
+ABC (:13) + ``MonitorMaster`` fan-out (:29) to TensorBoard
+(monitor/tensorboard.py:13), W&B (monitor/wandb.py:12) and CSV
+(monitor/csv_monitor.py:12). Events are ``(tag, value, step)`` tuples, written
+only from process 0 (rank gating as in the reference).
+"""
+
+from __future__ import annotations
+
+import abc
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+def _is_rank_zero() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class Monitor(abc.ABC):
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.enabled = getattr(monitor_config, "enabled", False)
+
+    @abc.abstractmethod
+    def write_events(self, event_list: List[Event]) -> None:
+        ...
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.summary_writer = None
+        if self.enabled and _is_rank_zero():
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = os.path.join(tensorboard_config.output_path,
+                                    tensorboard_config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=path)
+            except Exception as e:  # tensorboard optional
+                logger.warning(f"TensorBoard monitor disabled: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event], flush: bool = True) -> None:
+        if self.summary_writer is None:
+            return
+        for event in event_list:
+            self.summary_writer.add_scalar(*event)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        if self.enabled and _is_rank_zero():
+            try:
+                import wandb
+
+                wandb.init(project=wandb_config.project, group=wandb_config.group,
+                           entity=wandb_config.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"W&B monitor disabled: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not (self.enabled and _is_rank_zero()):
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.filenames: dict = {}
+        if self.enabled and _is_rank_zero():
+            self.log_dir = os.path.join(csv_config.output_path or "csv_monitor",
+                                        csv_config.job_name)
+            os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not (self.enabled and _is_rank_zero()):
+            return
+        for name, value, step in event_list:
+            fname = os.path.join(self.log_dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all enabled monitors (reference monitor/monitor.py:29)."""
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.tb_monitor: Optional[TensorBoardMonitor] = None
+        self.wandb_monitor: Optional[WandbMonitor] = None
+        self.csv_monitor: Optional[csvMonitor] = None
+        self.enabled = monitor_config.enabled
+        if _is_rank_zero():
+            if monitor_config.tensorboard.enabled:
+                self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+            if monitor_config.wandb.enabled:
+                self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+            if monitor_config.csv_monitor.enabled:
+                self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not _is_rank_zero():
+            return
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m is not None and m.enabled:
+                m.write_events(event_list)

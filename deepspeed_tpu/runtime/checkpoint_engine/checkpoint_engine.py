@@ -1,0 +1,143 @@
+"""Checkpoint-engine abstraction.
+
+Capability parity with reference
+``runtime/checkpoint_engine/checkpoint_engine.py:9`` (``CheckpointEngine``
+ABC: create/save/load/commit) and ``torch_checkpoint_engine.py:12``. The
+default implementation serializes JAX pytrees (state dicts of numpy arrays)
+with an ``.npz`` + tree-structure JSON format; an async engine (Nebula-style
+tiering, nebula_checkpoint_engine.py:20) can subclass the same surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ...utils.logging import log_dist, logger
+
+
+class CheckpointEngine:
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag: str) -> None:
+        """Hook for per-tag setup (log/start async session)."""
+        log_dist(f"[DSTPU] Saving checkpoint tag {tag}", ranks=[0])
+
+    def makedirs(self, path: str, exist_ok: bool = False) -> None:
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def save(self, state_dict: Any, path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None) -> Any:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        """Mark all saves for ``tag`` durable (the ``latest`` protocol relies
+        on this ordering)."""
+        return True
+
+
+def _flatten_state_dict(sd: Any, prefix: str = "") -> dict:
+    flat = {}
+    if isinstance(sd, dict):
+        for k, v in sd.items():
+            flat.update(_flatten_state_dict(v, f"{prefix}{k}/"))
+    elif isinstance(sd, (list, tuple)):
+        for i, v in enumerate(sd):
+            flat.update(_flatten_state_dict(v, f"{prefix}{i}/"))
+    else:
+        flat[prefix[:-1]] = sd
+    return flat
+
+
+class ArrayCheckpointEngine(CheckpointEngine):
+    """Default synchronous engine: one ``.npz`` of arrays + a pickle for
+    non-array leaves (the torch.save analog, torch_checkpoint_engine.py:12)."""
+
+    # ml_dtypes (bfloat16, fp8) are not numpy-native; persist them as raw
+    # integer views and record the true dtype in the sidecar metadata
+    _VIEW_DTYPES = {1: np.uint8, 2: np.uint16, 4: np.uint32}
+
+    def save(self, state_dict: Any, path: str) -> None:
+        flat = _flatten_state_dict(state_dict)
+        arrays = {}
+        dtypes = {}
+        others = {}
+        for k, v in flat.items():
+            if v is None:
+                others[k] = None
+                continue
+            try:
+                arr = np.asarray(v)
+                if arr.dtype == object:
+                    raise ValueError
+                if arr.dtype.name not in ("float64", "float32", "float16", "int64",
+                                          "int32", "int16", "int8", "uint8", "uint16",
+                                          "uint32", "uint64", "bool"):
+                    dtypes[k] = arr.dtype.name  # e.g. bfloat16, float8_e4m3fn
+                    arr = arr.view(self._VIEW_DTYPES[arr.dtype.itemsize])
+                arrays[k] = arr
+            except (ValueError, TypeError):
+                others[k] = v
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # npz keys can't contain some chars on all systems; index them
+        index = {f"a{i}": k for i, k in enumerate(sorted(arrays))}
+        np.savez(path + ".npz", **{f"a{i}": arrays[k]
+                                   for i, k in enumerate(sorted(arrays))})
+        with open(path + ".meta", "wb") as fh:
+            pickle.dump({"index": index, "others": others, "dtypes": dtypes}, fh)
+        logger.debug(f"saved checkpoint shard {path}")
+
+    def load(self, path: str, map_location=None) -> dict:
+        import ml_dtypes
+
+        with open(path + ".meta", "rb") as fh:
+            meta = pickle.load(fh)
+        data = np.load(path + ".npz", allow_pickle=False)
+        flat = {}
+        for ak, key in meta["index"].items():
+            arr = data[ak]
+            if key in meta.get("dtypes", {}):
+                arr = arr.view(getattr(ml_dtypes, meta["dtypes"][key]))
+            flat[key] = arr
+        flat.update(meta["others"])
+        # unflatten into nested dicts
+        nested: dict = {}
+        for key, value in flat.items():
+            parts = key.split("/")
+            d = nested
+            for p in parts[:-1]:
+                d = d.setdefault(p, {})
+            d[parts[-1]] = value
+        return nested
+
+
+def write_latest(save_dir: str, tag: str) -> None:
+    """``latest`` tag file protocol (reference engine.py:3045)."""
+    with open(os.path.join(save_dir, "latest"), "w") as fh:
+        fh.write(tag)
+
+
+def read_latest(load_dir: str) -> str:
+    latest_path = os.path.join(load_dir, "latest")
+    with open(latest_path, "r") as fh:
+        return fh.read().strip()
+
+
+def checkpoint_meta_path(save_dir: str, tag: str, kind: str, mp_rank: int = 0,
+                         dp_rank: int = 0) -> str:
+    """Reference checkpoint naming (engine.py:2485-2503):
+    ``mp_rank_XX_model_states`` / ``zero_pp_rank_X_mp_rank_XX_optim_states``."""
+    if kind == "model":
+        name = f"mp_rank_{mp_rank:02d}_model_states"
+    elif kind == "optim":
+        name = f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states"
+    else:
+        raise ValueError(kind)
+    return os.path.join(save_dir, str(tag), name)

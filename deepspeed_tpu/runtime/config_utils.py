@@ -54,6 +54,10 @@ class DeepSpeedConfigModel(BaseModel):
             key = field.alias or name
             if key not in values:
                 continue
+            # assignment re-validation passes current field values back in;
+            # only a non-default value signals actual user intent
+            if values[key] == field.default:
+                continue
             new_param = extra.get("new_param", "")
             logger.warning(f"Config parameter {key} is deprecated" +
                            (f", use {new_param} instead" if new_param else ""))

