@@ -78,7 +78,11 @@ def initialize(args=None,
 def init_inference(model: Any = None, config: Union[str, Dict, None] = None, **kwargs):
     """Build the inference engine (≅ reference ``deepspeed.init_inference``,
     deepspeed/__init__.py:260)."""
-    from .inference.engine import InferenceEngine
+    try:
+        from .inference.engine import InferenceEngine
+    except ImportError as e:
+        raise NotImplementedError(
+            "inference engine not built yet in this round") from e
 
     return InferenceEngine(model=model, config=config, **kwargs)
 

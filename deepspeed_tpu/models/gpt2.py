@@ -94,6 +94,10 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(B, T, H, C // H)
 
         if cfg.use_flash_attention:
+            if cfg.dropout > 0:
+                raise ValueError(
+                    "use_flash_attention does not support attention-probability "
+                    "dropout (dropout>0); use the dense path or dropout=0")
             from ..ops.attention.flash_attention import flash_attention
 
             y = flash_attention(q, k, v, causal=True)

@@ -1,0 +1,7 @@
+from .layer import ExpertMLP, MoE, moe_sharding_rules  # noqa: F401
+from .sharded_moe import (  # noqa: F401
+    combine_output,
+    gate_and_dispatch,
+    top1gating,
+    top2gating,
+)

@@ -1,0 +1,353 @@
+"""Fused flash attention (forward + backward) as Pallas TPU kernels.
+
+TPU-native replacement for the reference's fused attention kernels — the
+training transformer kernel's softmax/attention path
+(csrc/transformer/softmax_kernels.cu + ds_transformer_cuda.cpp) and the
+flash-style parity piece called out in SURVEY §2.2. Online-softmax tiling
+(Flash-Attention-2 style) keeps the (T×T) score matrix out of HBM: scores are
+computed block-by-block in VMEM, the MXU does the two matmuls per block, and
+running max/sum statistics rescale the accumulator.
+
+VMEM stays O(block), not O(seq): the KV axis is a grid dimension (TPU grids
+execute sequentially, innermost-last, so VMEM scratch carries the
+accumulator/stats across KV iterations of one Q block) — Pallas DMAs only the
+current (block, d) tiles. Causal masking skips fully-masked blocks.
+
+Layout: (batch, seq, heads, head_dim) in, same out. Backward follows the
+standard recompute scheme: store only ``lse`` (per-row log-sum-exp); dq and
+dk/dv are two kernels gridding the opposite axes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward: grid (bh, q_blocks, kv_blocks), scratch carries (acc, m, l)
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale: float, causal: bool):
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: skip blocks entirely above the diagonal
+    live = (not causal) or (k_start < q_start + block_q)
+
+    @pl.when(jnp.asarray(live))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(j == num_kv - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int):
+    bh, seq_q, d = q.shape
+    _, seq_k, _ = k.shape
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    assert seq_q % block_q == 0 and seq_k % block_k == 0, \
+        f"seq ({seq_q},{seq_k}) must be divisible by blocks ({block_q},{block_k})"
+
+    grid = (bh, seq_q // block_q, seq_k // block_k)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc_ref, *, scale: float, causal: bool):
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    live = (not causal) or (k_start < q_start + block_q)
+
+    @pl.when(jnp.asarray(live))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc_ref[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                             preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_kv - 1)
+    def _finish():
+        dq_ref[0] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    dk_acc_ref, dv_acc_ref, *, scale: float, causal: bool):
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+    ki = pl.program_id(1)
+    i = pl.program_id(2)
+    num_q = pl.num_programs(2)
+    k_start = ki * block_k
+    q_start = i * block_q
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    # causal: this k block only receives grads from q rows >= k_start
+    live = (not causal) or (q_start + block_q > k_start)
+
+    @pl.when(jnp.asarray(live))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (bq, bk)
+        dv_acc_ref[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                             preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc_ref[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                             preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, *, causal: bool, scale: float, block_q: int,
+               block_k: int):
+    bh, seq_q, d = q.shape
+    _, seq_k, _ = k.shape
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    grid_q = (bh, seq_q // block_q, seq_k // block_k)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
+        grid=grid_q,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    grid_k = (bh, seq_k // block_k, seq_q // block_q)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
+        grid=grid_k,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
+                        block_k=block_k)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
+                          block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal=causal, scale=scale,
+                            block_q=block_q, block_k=block_k)
+    return dq, dk, dv
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+    """Fused attention. q/k/v: (batch, seq, heads, head_dim) → same-shape out.
+
+    ``scale`` defaults to 1/sqrt(head_dim).
+    """
+    b, t, h, d = q.shape
+    _, s, _, _ = k.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    # (B, T, H, D) → (B*H, T, D)
+    def to_bh(x, T):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, T, d)
+
+    out = _flash_attention(to_bh(q, t), to_bh(k, s), to_bh(v, s), causal, scale,
+                           block_q, block_k)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def mha_reference(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Plain jnp attention for kernel equivalence tests (the analog of the
+    reference's kernel-vs-PyTorch numerics tests, tests/unit/ops/transformer)."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, k.shape[1]), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)

@@ -161,7 +161,9 @@ class DeepSpeedEngine:
         self._jit_accumulate = None
         self._jit_apply = None
         self._grad_acc = None
+        self._loss_acc = 0.0  # eager-path loss accumulator for logging
         self._pending = None  # (loss, grads) stashed by forward()
+        self._train_iter = None
 
         self.training_dataloader = self.deepspeed_io(training_data, collate_fn) \
             if training_data is not None else None
@@ -219,8 +221,26 @@ class DeepSpeedEngine:
             raise ValueError("initialize() needs a model (flax Module) or loss_fn")
         if hasattr(model, "apply"):  # flax.linen.Module convention
             def flax_loss(params, batch, rng):
-                return model.apply({"params": params}, batch,
-                                   rngs={"dropout": rng} if rng is not None else None)
+                rngs = None
+                if rng is not None:
+                    r1, r2 = jax.random.split(rng)
+                    rngs = {"dropout": r1, "gating": r2}
+                out = model.apply({"params": params}, batch, rngs=rngs)
+                # convention: a tuple return is (loss, aux_loss, *ignored) —
+                # ONLY element 1 is folded in (must be scalar, e.g. the MoE
+                # load-balancing loss); further elements are metrics and are
+                # never differentiated
+                if isinstance(out, tuple):
+                    loss = out[0]
+                    if len(out) > 1 and out[1] is not None:
+                        aux = out[1]
+                        if jnp.ndim(aux) != 0:
+                            raise ValueError(
+                                "model returned non-scalar aux loss (tuple "
+                                "element 1 must be a scalar added to the loss)")
+                        loss = loss + aux
+                    return loss
+                return out
 
             return flax_loss
         if callable(model):
@@ -486,7 +506,13 @@ class DeepSpeedEngine:
         program — ≅ PipelineEngine.train_batch semantics for the non-pipeline
         engine, and the recommended TPU hot path."""
         if data_iter is None and batch is None and self.training_dataloader is not None:
-            data_iter = iter(self.training_dataloader)
+            # persistent repeating iterator — successive calls advance through
+            # the dataset instead of restarting at batch 0
+            if self._train_iter is None:
+                from .dataloader import RepeatingLoader
+
+                self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._train_iter
         assert (data_iter is None) != (batch is None), \
             "pass exactly one of data_iter / batch"
         source = data_iter if data_iter is not None else batch
@@ -559,8 +585,9 @@ class DeepSpeedEngine:
         this is the accumulation half of the reference's IPG bucketing."""
         assert self._pending is not None, "backward() before forward()"
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        _, grads = self._pending
+        micro_loss, grads = self._pending
         self._pending = None
+        self._loss_acc = self._loss_acc + micro_loss
         if self._grad_acc is None:
             self._grad_acc = grads
         else:
@@ -585,7 +612,8 @@ class DeepSpeedEngine:
             self.skipped_steps += 1
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
-        metrics["loss"] = jnp.asarray(0.0)
+        metrics["loss"] = self._loss_acc / n
+        self._loss_acc = 0.0
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._after_step(metrics)
 
@@ -595,6 +623,13 @@ class DeepSpeedEngine:
     def _state_dict(self) -> Dict:
         import flax.serialization as fser
 
+        if dist.get_world_size() > 1:
+            # TODO(multi-host): per-process shard files
+            # (zero_pp_rank_* naming is already in checkpoint_meta_path);
+            # device_get would raise on non-addressable shards.
+            raise NotImplementedError(
+                "multi-host checkpointing lands with the universal-checkpoint "
+                "work; single-host (any chip count) is supported")
         host = jax.device_get(self.state)
         sd = {
             "module": fser.to_state_dict(host["params"]),
