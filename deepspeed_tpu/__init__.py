@@ -64,7 +64,7 @@ def initialize(args=None,
                                 model_parameters=model_parameters,
                                 training_data=training_data,
                                 lr_scheduler=lr_scheduler, collate_fn=collate_fn,
-                                mesh=mesh)
+                                mesh=mesh, sharding_rules=sharding_rules)
     else:
         engine = DeepSpeedEngine(model=model, loss_fn=loss_fn,
                                  model_parameters=model_parameters,

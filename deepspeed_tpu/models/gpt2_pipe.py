@@ -1,0 +1,92 @@
+"""GPT-2 as a PipelineModule — the 3D-parallel (DP × PP × TP) flagship.
+
+Capability parity target: the reference's Megatron-GPT2 pipeline configs
+(``PipeModelDataParallelTopology``, reference pipe/topology.py:244, and the
+GPT2 model tests under tests/model/Megatron_GPT2). Blocks reuse
+``models/gpt2.Block``; the head is untied (NeoX-style) so stages stay
+homogeneous.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import MODEL_AXIS, PIPE_AXIS
+from ..runtime.pipe.module import LayerSpec, PipelineModule
+from .gpt2 import Block, GPT2Config
+
+
+class GPT2Embed(nn.Module):
+    """Stage-0 embedding (wte + wpe) consuming the micro-batch dict."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, micro_batch):
+        cfg = self.config
+        ids = micro_batch["input_ids"]
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")
+        T = ids.shape[-1]
+        return wte(ids) + wpe(jnp.arange(T)[None, :])
+
+
+class GPT2Head(nn.Module):
+    """Final LN + untied LM head producing logits."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_f")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")(x.astype(jnp.float32))
+
+
+def gpt2_lm_loss(logits, micro_batch):
+    """Shifted causal cross-entropy; -100/-1 labels are ignored."""
+    input_ids = micro_batch["input_ids"]
+    labels = micro_batch.get("labels", input_ids) \
+        if hasattr(micro_batch, "get") else input_ids
+    logits = logits[:, :-1]
+    targets = labels[:, 1:]
+    mask = (targets >= 0).astype(jnp.float32)
+    targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def gpt2_pipe_module(config: GPT2Config, num_stages: int,
+                     activation_checkpoint_interval: int = 1) -> PipelineModule:
+    layers: Tuple = tuple(
+        [LayerSpec(GPT2Embed, config)]
+        + [LayerSpec(Block, config)] * config.n_layer
+        + [LayerSpec(GPT2Head, config)])
+    return PipelineModule(layers=layers, loss_fn=gpt2_lm_loss,
+                          num_stages=num_stages,
+                          activation_checkpoint_interval=activation_checkpoint_interval)
+
+
+def gpt2_pipe_sharding_rules():
+    """Composed pipe × tensor-parallel rules for the stacked block params
+    (rank 4: stage, local_layer, in, out). Specific TP rules first; the
+    trailing blocks/ rule pipe-shards everything else (LN params, etc.)."""
+    M, P = MODEL_AXIS, PIPE_AXIS
+    return [
+        (r"attn/qkv/kernel", (P, None, None, M)),   # column parallel
+        (r"attn/proj/kernel", (P, None, M, None)),  # row parallel
+        (r"mlp/fc/kernel", (P, None, None, M)),     # column parallel
+        (r"mlp/proj/kernel", (P, None, M, None)),   # row parallel
+        (r"attn/qkv/bias", (P, None, M)),
+        (r"mlp/fc/bias", (P, None, M)),
+        (r"wte/embedding", (M, None)),              # vocab-parallel embedding
+        (r"lm_head/kernel", (None, M)),             # column-parallel head
+        (r"blocks/", (P,)),
+    ]

@@ -428,28 +428,13 @@ class DeepSpeedEngine:
             }
             return new_state, metrics
 
+        grads_fn = self._make_grads_fn(micro_grads, constrain_grads, scale_value, gas)
+
         def fused_train_batch(state, stacked_batch):
-            """One global step: scan over gas micro-batches + update."""
-            params = state["params"]
-            scale = scale_value(state)
-            rng = jax.random.fold_in(state["rng"], state["step"])
-
-            zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
-
-            def body(carry, mb):
-                acc, loss_sum, r = carry
-                r, sub = jax.random.split(r)
-                loss, grads = micro_grads(params, mb, sub, scale)
-                acc = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
-                acc = constrain_grads(acc, params)
-                return (acc, loss_sum + loss, r), None
-
-            (grads_sum, loss_sum, _), _ = jax.lax.scan(
-                body, (zeros, jnp.asarray(0.0, jnp.float32), rng), stacked_batch)
-            new_state, metrics = update_from_grads(state, grads_sum, float(gas))
-            metrics["loss"] = loss_sum / gas
+            """One global step: grads over gas micro-batches + update."""
+            loss, grads_sum, denom = grads_fn(state, stacked_batch)
+            new_state, metrics = update_from_grads(state, grads_sum, denom)
+            metrics["loss"] = loss
             return new_state, metrics
 
         def one_micro(state, batch, micro_index):
@@ -471,6 +456,33 @@ class DeepSpeedEngine:
             lambda state, acc, n: update_from_grads(state, acc, n),
             donate_argnums=(0,), static_argnums=(2,),
             out_shardings=(state_sh, None))
+
+    def _make_grads_fn(self, micro_grads, constrain_grads, scale_value, gas):
+        """Default gradient strategy: lax.scan over the gas micro-batches
+        accumulating into a (sharding-constrained) sum. PipelineEngine
+        overrides this to feed all micro-batches into the pipelined loss."""
+
+        def grads_fn(state, stacked_batch):
+            params = state["params"]
+            scale = scale_value(state)
+            rng = jax.random.fold_in(state["rng"], state["step"])
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+            def body(carry, mb):
+                acc, loss_sum, r = carry
+                r, sub = jax.random.split(r)
+                loss, grads = micro_grads(params, mb, sub, scale)
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                acc = constrain_grads(acc, params)
+                return (acc, loss_sum + loss, r), None
+
+            (grads_sum, loss_sum, _), _ = jax.lax.scan(
+                body, (zeros, jnp.asarray(0.0, jnp.float32), rng), stacked_batch)
+            return loss_sum / gas, grads_sum, float(gas)
+
+        return grads_fn
 
     # ------------------------------------------------------------------
     # data

@@ -1,0 +1,319 @@
+"""Pipeline-parallel module expression.
+
+Capability parity with reference ``deepspeed/runtime/pipe/module.py`` —
+``LayerSpec`` (:29), ``TiedLayerSpec`` (:76), ``PipelineModule`` (:85) with
+layer partitioning (:353). TPU-native execution model:
+
+The reference materializes only the local stage's layers per rank and moves
+activations with P2P sends. Here the whole network lives as ONE parameter
+tree: the homogeneous transformer blocks are stacked ``(S, L/S, ...)`` —
+outer dim sharded over the ``pipe`` mesh axis (each stage stores only its
+chunk) — and the microbatch loop rotates a stage-sharded activation buffer
+with ``jnp.roll`` along the pipe-sharded dim, which XLA lowers to a
+``collective-permute`` between neighboring stages (the reference's
+``p2p.send/recv``, runtime/pipe/p2p.py). The whole GPipe loop (warmup +
+steady state + drain = M + S - 1 ticks, matching ``TrainSchedule``'s
+forward tick count) is inside the one compiled train step; the backward
+schedule is the autodiff transpose (reverse collective-permutes), and
+per-tick ``remat`` bounds activation memory like the reference's
+activation-checkpointed pipeline.
+
+Tied layers: ``TiedLayerSpec`` reuses one module instance (e.g. the
+embedding used again as the LM head). Tied params are replicated across
+``pipe`` and GSPMD sums their gradient contributions — the reference's
+tied-weight allreduce (pipe/engine.py:225) is implicit.
+
+Constraint: the repeated middle run of specs must be homogeneous (same
+class/kwargs) with total count divisible by the stage count — the standard
+LLM case. Heterogeneous pipelines raise with guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...parallel.mesh import PIPE_AXIS
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Deferred layer constructor (≅ reference module.py:29)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, nn.Module):
+            raise RuntimeError("LayerSpec only supports flax nn.Module types")
+
+    def build(self, name: Optional[str] = None) -> nn.Module:
+        kwargs = dict(self.module_kwargs)
+        if name is not None:
+            kwargs["name"] = name
+        return self.typename(*self.module_args, **kwargs)
+
+    def signature(self) -> Tuple:
+        return (self.typename, self.module_args, tuple(sorted(
+            (k, repr(v)) for k, v in self.module_kwargs.items())))
+
+    def __repr__(self):
+        return f"LayerSpec({self.typename.__name__})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared across occurrences by key
+    (≅ reference module.py:76)."""
+
+    def __init__(self, key: str, typename, *module_args, forward_fn=None,
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Split ``weights`` into ``num_parts`` contiguous chunks minimizing the
+    max chunk weight (≅ reference ds_utils.partition_balanced used by
+    PipelineModule._partition_layers). Returns part boundaries of length
+    num_parts+1."""
+    n = len(weights)
+    prefix = [0.0]
+    for w in weights:
+        prefix.append(prefix[-1] + w)
+
+    def parts_ok(limit: float) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            end = start
+            while end < n and prefix[end + 1] - prefix[start] <= limit:
+                end += 1
+            if end == start:  # single item exceeds limit
+                return None
+            bounds.append(end)
+            start = end
+            if end == n:
+                break
+        if bounds[-1] != n:
+            return None
+        while len(bounds) < num_parts + 1:
+            bounds.append(n)
+        return bounds
+
+    lo = max(weights) if weights else 0.0
+    hi = prefix[-1]
+    best = parts_ok(hi)
+    for _ in range(50):
+        mid = (lo + hi) / 2
+        cand = parts_ok(mid)
+        if cand is not None:
+            best, hi = cand, mid
+        else:
+            lo = mid
+    return best
+
+
+class _PipeScanBody(nn.Module):
+    """nn.scan body adapter: user blocks return x; scan needs (carry, out)."""
+
+    block_cls: type
+    block_args: Tuple = ()
+    block_kwargs: Tuple = ()  # sorted (key, value) pairs — hashable for flax
+    remat: bool = True
+
+    pass_deterministic: bool = False
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cls = self.block_cls
+        if self.remat:
+            cls = nn.remat(cls, prevent_cse=False,
+                           static_argnums=(2,) if self.pass_deterministic else ())
+        block = cls(*self.block_args, **dict(self.block_kwargs), name="block")
+        if self.pass_deterministic:
+            x = block(x, deterministic)
+        else:
+            x = block(x)
+        return x, None
+
+
+class _PipeTick(nn.Module):
+    """One pipeline tick: inject micro at stage 0, run every stage's local
+    blocks, emit the last stage's output, rotate the buffer. Head/loss run
+    at the PipelineModule level (keeps tied modules in one scope)."""
+
+    block_cls: type
+    block_args: Tuple = ()
+    block_kwargs: Tuple = ()
+    remat: bool = True
+    num_stages: int = 1
+    num_blocks: int = 1
+    pass_deterministic: bool = False
+
+    def setup(self):
+        L, S = self.num_blocks, self.num_stages
+        inner = nn.scan(
+            _PipeScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=L // S,
+            in_axes=nn.broadcast,  # deterministic flag
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        self.blocks = nn.vmap(
+            inner,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(0, None), out_axes=0,
+            metadata_params={nn.PARTITION_NAME: PIPE_AXIS},
+        )(block_cls=self.block_cls, block_args=self.block_args,
+          block_kwargs=self.block_kwargs, remat=self.remat,
+          pass_deterministic=self.pass_deterministic, name="blocks")
+
+    def __call__(self, carry, t, embedded, deterministic):
+        state = carry
+        S = self.num_stages
+        M = embedded.shape[0]
+        inject = jax.lax.dynamic_index_in_dim(
+            embedded, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+        x0 = jnp.where(t < M, inject, state[0])
+        state = state.at[0].set(x0)
+        y, _ = self.blocks(state, deterministic)  # (S, mb, ...) per stage
+        state = jnp.roll(y, 1, axis=0)  # stage i output → stage i+1 input
+        # emit last stage's output (valid for micro t-S+1 once t >= S-1)
+        return state, y[S - 1]
+
+
+class PipelineModule(nn.Module):
+    """Express a model as a sequence of layers pipelined over stages.
+
+    ``__call__(stacked_batch)`` consumes the micro-batch-stacked batch
+    (leading dim = num_micro_batches) and returns the mean loss.
+
+    Fields:
+      layers: tuple of LayerSpec — [pre..., block×L (homogeneous), post...]
+      loss_fn: (final_activations, micro_batch) -> scalar loss
+      num_stages: pipe-parallel degree (must match the mesh's pipe axis)
+      embed_fn_name: method on pre modules producing block input from batch
+      activation_checkpoint_interval: remat the tick body when > 0
+    """
+
+    layers: Tuple
+    loss_fn: Callable
+    num_stages: int
+    partition_method: str = "parameters"
+    activation_checkpoint_interval: int = 1
+    input_key: str = "input_ids"
+
+    def _split_specs(self):
+        specs = list(self.layers)
+        sigs = [s.signature() for s in specs]
+        # longest homogeneous run = the pipelined blocks
+        best_start, best_len = 0, 0
+        i = 0
+        while i < len(specs):
+            j = i
+            while j < len(specs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best_len:
+                best_start, best_len = i, j - i
+            i = j
+        if best_len < self.num_stages:
+            raise ValueError(
+                f"PipelineModule needs a homogeneous middle run of >= num_stages "
+                f"({self.num_stages}) identical LayerSpecs to pipeline; got run of "
+                f"{best_len}. Heterogeneous pipelines are not supported by the "
+                f"SPMD executor — make the repeated block a single module class.")
+        if best_len % self.num_stages != 0:
+            raise ValueError(
+                f"block count {best_len} not divisible by num_stages "
+                f"{self.num_stages}")
+        return (specs[:best_start], specs[best_start:best_start + best_len],
+                specs[best_start + best_len:])
+
+    def setup(self):
+        pre_specs, block_specs, post_specs = self._split_specs()
+        tied: Dict[str, nn.Module] = {}  # local: flax freezes dict attributes
+
+        def build(spec, idx, where):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied:
+                    tied[spec.key] = spec.build(name=f"tied_{spec.key}")
+                return tied[spec.key]
+            return spec.build(name=f"{where}_{idx}")
+
+        self.pre_layers = [build(s, i, "pre") for i, s in enumerate(pre_specs)]
+        self.post_layers = [build(s, i, "post") for i, s in enumerate(post_specs)]
+        self._post_specs = tuple(post_specs)
+
+        spec0 = block_specs[0]
+        import inspect
+
+        try:
+            sig = inspect.signature(spec0.typename.__call__)
+            pass_det = len([p for p in sig.parameters.values()
+                            if p.kind in (p.POSITIONAL_ONLY,
+                                          p.POSITIONAL_OR_KEYWORD)]) >= 3
+        except (TypeError, ValueError):
+            pass_det = False
+        # lifted scan over ticks: params broadcast across iterations
+        self.ticks = nn.scan(
+            _PipeTick,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            in_axes=(0, nn.broadcast, nn.broadcast),
+            out_axes=0,
+        )(block_cls=spec0.typename, block_args=spec0.module_args,
+          block_kwargs=tuple(sorted(spec0.module_kwargs.items())),
+          remat=bool(self.activation_checkpoint_interval),
+          num_stages=self.num_stages, num_blocks=len(block_specs),
+          pass_deterministic=pass_det, name="pipe")
+        self._num_blocks = len(block_specs)
+
+    def _embed(self, micro_batch):
+        x = micro_batch
+        for layer in self.pre_layers:
+            x = layer(x)
+        return x
+
+    def _head_loss(self, x, micro_batch):
+        for spec, layer in zip(self._post_specs, self.post_layers):
+            fwd = getattr(spec, "forward_fn", None)
+            x = fwd(layer, x) if fwd is not None else layer(x)
+        return self.loss_fn(x, micro_batch)
+
+    def __call__(self, stacked_batch, deterministic: bool = True):
+        S = self.num_stages
+        leaves = jax.tree_util.tree_leaves(stacked_batch)
+        M = leaves[0].shape[0]
+
+        # embed all micros up front (pre params replicated over pipe; this
+        # compute is tiny vs the blocks and keeps the tick body homogeneous)
+        embedded = jax.vmap(self._embed)(stacked_batch)  # (M, mb, T, D)
+        feat_shape = embedded.shape[1:]
+
+        state0 = jnp.zeros((S,) + feat_shape, embedded.dtype)
+        ts = jnp.arange(M + S - 1)
+        _, ys = self.ticks(state0, ts, embedded, deterministic)
+        # last stage emits micro m's output at tick m + S - 1
+        outputs = ys[S - 1:]  # (M, mb, ...)
+
+        # head + loss at module level: tied modules (e.g. embedding reused as
+        # LM head via TiedLayerSpec.forward_fn) share one scope here
+        losses = jax.vmap(self._head_loss)(outputs, stacked_batch)
+        return jnp.mean(losses)
+
+    def num_pipeline_ticks(self, num_micro_batches: int) -> int:
+        """forward ticks per global step = M + S - 1 (matches
+        InferenceSchedule's step count for the same M, S)."""
+        return num_micro_batches + self.num_stages - 1
+
+
+def pipe_sharding_rules():
+    """Sharding rule placing the stacked block params on the pipe axis
+    (dim 0 = stage). Specs are padded with None to each param's rank."""
+    return [(r"blocks/", (PIPE_AXIS,))]

@@ -1,0 +1,34 @@
+"""Stage-to-stage transfer primitives.
+
+Capability parity with reference ``deepspeed/runtime/pipe/p2p.py`` (send/recv/
+isend/irecv between adjacent stages, :23,30). On TPU there is no eager P2P:
+stage transfer inside the compiled pipeline is ``jnp.roll`` on the
+pipe-sharded buffer (→ XLA collective-permute; see module.py), and these
+helpers provide the explicit-collective form for shard_map code paths.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+from ...parallel.mesh import PIPE_AXIS
+
+
+def _ring_perm(n: int, shift: int = 1):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def send_to_next_stage(x, num_stages: int):
+    """Rotate activations one stage forward (stage i → i+1) inside a
+    shard_map over the pipe axis (≅ p2p.send of activations)."""
+    return lax.ppermute(x, PIPE_AXIS, _ring_perm(num_stages, 1))
+
+
+def send_to_prev_stage(x, num_stages: int):
+    """Rotate gradients one stage backward (stage i → i-1) — the transpose
+    direction (≅ p2p.send of grads)."""
+    return lax.ppermute(x, PIPE_AXIS, _ring_perm(num_stages, -1))
+
+
+def can_send_recv() -> bool:
+    return True

@@ -1,0 +1,268 @@
+"""Pipeline instruction schedules.
+
+Capability parity with reference ``deepspeed/runtime/pipe/schedule.py`` —
+``PipeSchedule`` ABC, ``InferenceSchedule`` (:135), ``TrainSchedule`` 1F1B
+(:189,197-257), ``DataParallelSchedule`` (:327) and the ``PipeInstruction``
+vocabulary.
+
+On TPU the *executed* schedule is a compiled SPMD loop (see ``engine.py``):
+every tick, all stages run one forward (and, in the autodiff transpose, one
+backward) and rotate activations over the ``pipe`` axis — a compiler-
+scheduled GPipe/1F1B hybrid. These instruction streams remain the
+*specification*: tests assert the SPMD loop's tick count and microbatch
+ordering against them, and an eager per-instruction executor can interpret
+them directly.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class PipeInstruction:
+    """Base instruction (≅ reference schedule.py PipeInstruction)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        if self.kwargs:
+            args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+            return f"{self.name}({args})"
+        return self.name
+
+    def __eq__(self, other):
+        return repr(self) == repr(other)
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Yields lists of instructions per step (≅ reference PipeSchedule)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    def steps(self):
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id: int) -> bool:
+        return 0 <= stage_id < self.stages
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelining (≅ reference schedule.py:135)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        cmds_per_step = []
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=micro_batch_id % 2))
+                else:
+                    cmds.append(RecvActivation(buffer_id=micro_batch_id % 2))
+                cmds.append(ForwardPass(buffer_id=micro_batch_id % 2))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=micro_batch_id % 2))
+            cmds_per_step.append(cmds)
+        return cmds_per_step
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B training schedule (≅ reference schedule.py:189).
+
+    Steady state interleaves one forward with one backward per step; warmup
+    fills the pipeline with forwards, cooldown drains with backwards.
+    """
+
+    def steps(self):
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        cmds_per_step = []
+        prev_micro_batch_id = -1
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+
+            # exchange activations/grads with neighbors
+            if self._valid_micro_batch(prev_micro_batch_id):
+                if is_forward:
+                    if self._valid_stage(self.prev_stage):
+                        cmds.append(SendGrad(buffer_id=self._buffer_idx(
+                            prev_micro_batch_id)))
+                else:
+                    if self._valid_stage(self.next_stage):
+                        cmds.append(SendActivation(buffer_id=self._buffer_idx(
+                            prev_micro_batch_id)))
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self._valid_stage(self.prev_stage):
+                        cmds.append(RecvActivation(buffer_id=self._buffer_idx(
+                            micro_batch_id)))
+                else:
+                    if self._valid_stage(self.next_stage):
+                        cmds.append(RecvGrad(buffer_id=self._buffer_idx(
+                            micro_batch_id)))
+
+            # compute
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self.is_first_stage or self.is_last_stage:
+                        cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(
+                            micro_batch_id)))
+                    cmds.append(ForwardPass(buffer_id=self._buffer_idx(
+                        micro_batch_id)))
+                else:
+                    cmds.append(BackwardPass(buffer_id=self._buffer_idx(
+                        micro_batch_id)))
+
+            # last step: reduce + optimizer
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            cmds_per_step.append(cmds)
+        return cmds_per_step
+
+    def _step_to_micro_batch(self, step_id):
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        elif _is_odd(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        else:
+            raise AssertionError("unreachable")
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        base = step_id // 2
+        return int(base - self.stage_id // 2)
+
+    def _odd_step_forward_id(self, step_id):
+        base = (step_id - 1) // 2
+        return int(base - self.stage_id // 2)
+
+    def _even_step_backward_id(self, step_id):
+        base = step_id // 2
+        return int(base - self.stages + (self.stage_id + 1) // 2)
+
+    def _odd_step_backward_id(self, step_id):
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return int(base + self.stage_id // 2)
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def num_pipe_buffers(self) -> int:
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (≅ reference schedule.py:327)."""
+
+    def steps(self):
+        cmds_per_step = []
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(buffer_id=0), ForwardPass(buffer_id=0),
+                    BackwardPass(buffer_id=0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            cmds_per_step.append(cmds)
+        return cmds_per_step
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0

@@ -124,7 +124,8 @@ class ShardingRules:
     """
 
     def __init__(self, rules: Optional[Sequence[Tuple[str, Sequence]]] = None):
-        self.rules = [(re.compile(pat), PartitionSpec(*spec)) for pat, spec in (rules or [])]
+        self.raw_rules = list(rules or [])
+        self.rules = [(re.compile(pat), PartitionSpec(*spec)) for pat, spec in self.raw_rules]
 
     def spec_for(self, path: str) -> Optional[PartitionSpec]:
         for pat, spec in self.rules:
