@@ -41,6 +41,9 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     remat: bool = False  # activation checkpointing per block
     use_flash_attention: bool = False  # Pallas kernel (TPU only)
+    # sequence/context parallelism over the `seq` mesh axis:
+    # None | "ring" (ppermute KV rotation) | "ulysses" (all-to-all head swap)
+    sequence_parallel: Optional[str] = None
 
 
 # sizes for the standard family
@@ -93,7 +96,26 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
 
-        if cfg.use_flash_attention:
+        if cfg.sequence_parallel:
+            if cfg.sequence_parallel not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"sequence_parallel must be 'ring' or 'ulysses', "
+                    f"got {cfg.sequence_parallel!r}")
+            if cfg.dropout > 0:
+                raise ValueError(
+                    "sequence_parallel does not support attention-probability "
+                    "dropout (dropout>0)")
+            from ..ops.attention.sequence_parallel import (
+                ring_attention,
+                ulysses_attention,
+            )
+            from ..parallel.mesh import get_model_parallel_world_size
+
+            head_axes = MODEL_AXIS if get_model_parallel_world_size() > 1 else None
+            sp_fn = ring_attention if cfg.sequence_parallel == "ring" \
+                else ulysses_attention
+            y = sp_fn(q, k, v, causal=True, head_axes=head_axes)
+        elif cfg.use_flash_attention:
             if cfg.dropout > 0:
                 raise ValueError(
                     "use_flash_attention does not support attention-probability "

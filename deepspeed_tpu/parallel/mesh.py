@@ -43,10 +43,14 @@ MODEL_AXIS = "model"
 MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 # Axes over which ZeRO (sharded-DP) state is partitioned. `expert` and `seq`
-# multiply into the effective DP world when enabled.
+# multiply into the ZeRO shard world when enabled: params/optimizer state may
+# shard over `seq` too (grads are psummed over it by GSPMD since the sp group
+# works on chunks of the SAME samples — ZeRO+Ulysses composition).
 ZERO_AXES = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
-# Axes over which the global batch is split.
-BATCH_AXES = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+# Axes over which the global batch (sample dim) is split. `seq` is NOT a
+# batch axis: it shards the SEQUENCE dim of each sample (ring/Ulysses
+# attention, ops/attention/sequence_parallel.py).
+BATCH_AXES = (DATA_AXIS, EXPERT_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -174,8 +178,10 @@ def _axis_size(axis: str) -> int:
 
 # --- world-size accessors, mirroring deepspeed/utils/groups.py getters ---
 def get_data_parallel_world_size() -> int:
-    # "data parallel" in the ZeRO sense = every axis ZeRO state shards over.
-    return math.prod(_axis_size(a) for a in ZERO_AXES)
+    """Number of model replicas in the batch sense — the multiplier in
+    ``train_batch = micro_batch × gas × dp_world``. Excludes ``seq``: a
+    sequence-parallel group cooperates on the *same* samples."""
+    return math.prod(_axis_size(a) for a in BATCH_AXES)
 
 
 def get_model_parallel_world_size() -> int:

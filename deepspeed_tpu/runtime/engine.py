@@ -86,7 +86,9 @@ class DeepSpeedEngine:
         dist.init_distributed()
 
         # --- config -------------------------------------------------------
-        # world size for batch math = number of model replicas = ZeRO world
+        # world size for batch math = batch replicas (data×expert). The ZeRO
+        # shard world is a DIFFERENT number when a seq axis is active (it
+        # includes seq; see zero/policy._zero_world) — don't conflate them.
         if mesh is not None:
             mesh_mod.set_mesh(mesh)
         elif not mesh_mod.has_mesh():
@@ -253,7 +255,11 @@ class DeepSpeedEngine:
         if not hasattr(self.module, "init"):
             raise ValueError("model has no .init; pass model_parameters to initialize()")
         rng = jax.random.PRNGKey(self._rng_seed)
-        micro = jax.tree_util.tree_map(lambda x: np.asarray(x[:1]), batch)
+        # smallest batch-world-divisible slice (shard_map'd models — e.g.
+        # sequence-parallel attention — require divisible shapes even at init)
+        n = self.dp_world_size
+        micro = jax.tree_util.tree_map(
+            lambda x: np.asarray(x[:min(len(x), n)]), batch)
         variables = self.module.init({"params": rng, "dropout": rng}, micro)
         return variables["params"]
 
@@ -321,10 +327,19 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # compiled functions
     # ------------------------------------------------------------------
+    def _batch_leaf_sharding(self, ndim: int, scan_dim: bool = False):
+        """Sharding for one batch leaf: sample dim over the batch axes and —
+        when a ``seq`` mesh axis is active — dim 1 (the sequence dim) over it
+        (sequence parallelism; ring/Ulysses attention consumes that layout)."""
+        entries = [None] if scan_dim else []
+        entries.append(tuple(mesh_mod.BATCH_AXES))
+        if mesh_mod.get_sequence_parallel_world_size() > 1 and ndim > len(entries):
+            entries.append(mesh_mod.SEQ_AXIS)
+        return NamedSharding(self.mesh, PartitionSpec(*entries))
+
     def _batch_sharding(self, batch):
-        spec = PartitionSpec(tuple(mesh_mod.BATCH_AXES))
-        sh = NamedSharding(self.mesh, spec)
-        return jax.tree_util.tree_map(lambda _: sh, batch)
+        return jax.tree_util.tree_map(
+            lambda x: self._batch_leaf_sharding(np.ndim(x)), batch)
 
     def _grad_shardings(self, params_like):
         return self.policy.grad_shardings(params_like)
@@ -509,9 +524,12 @@ class DeepSpeedEngine:
                 return x.reshape((gas, global_micro) + x.shape[1:])
 
             stacked = jax.tree_util.tree_map(reshape, batch_or_iter)
-        # micro dim (1) shards over the batch axes; scan dim (0) replicated
-        sh = NamedSharding(self.mesh, PartitionSpec(None, tuple(mesh_mod.BATCH_AXES)))
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), stacked)
+        # micro dim (1) shards over the batch axes; scan dim (0) replicated;
+        # sequence dim (2) over `seq` when sequence parallelism is on
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, self._batch_leaf_sharding(np.ndim(x), scan_dim=True)),
+            stacked)
 
     def train_batch(self, data_iter=None, batch=None):
         """Run one full global step (gas micro-batches) as a single compiled
@@ -578,9 +596,9 @@ class DeepSpeedEngine:
         if self.state is None:
             self._build_state(self._init_params_from_batch(batch))
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        sh = NamedSharding(self.mesh, PartitionSpec(tuple(mesh_mod.BATCH_AXES)))
         batch = jax.tree_util.tree_map(
-            lambda x: jax.device_put(np.asarray(x), sh), batch)
+            lambda x: jax.device_put(
+                np.asarray(x), self._batch_leaf_sharding(np.ndim(x))), batch)
         loss, grads = self._jit_micro(
             self.state, batch,
             jnp.asarray(self.micro_steps % self.gradient_accumulation_steps(),

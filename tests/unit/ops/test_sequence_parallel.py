@@ -1,0 +1,98 @@
+"""Sequence-parallelism correctness: ring / Ulysses vs dense reference.
+
+Runs on the virtual 8-device CPU mesh (conftest). The dense reference is
+plain softmax attention over the full sequence; the sequence-parallel
+implementations must match it to fp32 tolerance, including gradients
+(ppermute/all_to_all have transpose rules, so the whole thing is
+differentiable end-to-end — that is what makes it usable for training).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention.sequence_parallel import (
+    DistributedAttention,
+    _dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from deepspeed_tpu.parallel import initialize_mesh
+
+
+def _make_qkv(B=2, S=32, H=4, D=8, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, S, H, D)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.fixture
+def seq_mesh():
+    # data=2 × seq=4 over the 8 CPU devices
+    return initialize_mesh(data=2, seq=4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(seq_mesh, causal):
+    q, k, v = _make_qkv()
+    want = _dense_attention(q, k, v, causal=causal, scale=1.0 / np.sqrt(8))
+    got = ring_attention(q, k, v, mesh=seq_mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_dense(seq_mesh, causal):
+    q, k, v = _make_qkv()
+    want = _dense_attention(q, k, v, causal=causal, scale=1.0 / np.sqrt(8))
+    got = ulysses_attention(q, k, v, mesh=seq_mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_dense(seq_mesh):
+    q, k, v = _make_qkv(B=2, S=16, H=2, D=4, seed=1)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=seq_mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            _dense_attention(q, k, v, causal=True, scale=0.5) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_distributed_attention_wrapper(seq_mesh):
+    q, k, v = _make_qkv(seed=2)
+    want = _dense_attention(q, k, v, causal=True, scale=1.0 / np.sqrt(8))
+    for strategy in ("ring", "ulysses"):
+        attn = DistributedAttention(strategy=strategy, mesh=seq_mesh, causal=True)
+        got = attn(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ring_under_jit_sharded_inputs(seq_mesh):
+    """ring attention composes with jit + explicitly sharded inputs (the way
+    the engine will call it): inputs placed seq-sharded, no resharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    q, k, v = _make_qkv(seed=3)
+    sh = NamedSharding(seq_mesh, P("data", "seq", None, None))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, mesh=seq_mesh, causal=True)
+
+    got = f(q, k, v)
+    want = _dense_attention(np.asarray(q), np.asarray(k), np.asarray(v),
+                            causal=True, scale=1.0 / np.sqrt(8))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -1,0 +1,58 @@
+"""Engine-level sequence parallelism: GPT-2 training with the `seq` mesh axis.
+
+Verifies the full composition — batch dim sharded over `data`, sequence dim
+sharded over `seq`, ring/Ulysses attention inside the compiled train step,
+ZeRO state sharded over (data, expert, seq) — produces the same losses as the
+plain data-parallel run (same seed, same batches).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel import initialize_mesh, reset_mesh
+
+
+def _train_losses(mesh_kwargs, model_cfg_kwargs, steps=3, zero_stage=2):
+    reset_mesh()
+    mesh = initialize_mesh(**mesh_kwargs)
+    cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
+                     n_head=4, dtype=jnp.float32, **model_cfg_kwargs)
+    engine, _, _, _ = ds.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "zero_optimization": {"stage": zero_stage},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        },
+        mesh=mesh)
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(steps):
+        batch = {"input_ids": rng.integers(
+            0, 128, (engine.train_batch_size(), 32)).astype(np.int32)}
+        losses.append(float(engine.train_batch(batch=batch)))
+    return losses
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_sp_training_matches_dp(strategy):
+    # dp=2 × sp=4: batch of 2 samples' sequences split 4 ways
+    sp_losses = _train_losses({"data": 2, "seq": 4},
+                              {"sequence_parallel": strategy})
+    # same batch world (dp=2) without sequence parallelism; tp=4 absorbs the
+    # remaining devices and is mathematically identical
+    dp_losses = _train_losses({"data": 2, "model": 4}, {})
+    np.testing.assert_allclose(sp_losses, dp_losses, rtol=2e-4, atol=2e-4)
+    assert all(np.isfinite(sp_losses))
+
+
+def test_sp_with_tp_composes():
+    # dp=2 × sp=2 × tp=2
+    losses = _train_losses({"data": 2, "seq": 2, "model": 2},
+                           {"sequence_parallel": "ring"}, zero_stage=3)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 1.5  # sanity: not diverging wildly
