@@ -1,0 +1,71 @@
+"""Inference config (≅ reference ``deepspeed/inference/config.py:126
+DeepSpeedInferenceConfig``): same JSON surface, pydantic-typed.
+
+Keys the reference exposes that are CUDA-machinery (``enable_cuda_graph``,
+``use_triton``) are accepted for config compatibility and ignored — their
+TPU equivalents (whole-graph jit compile) are always on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """≅ reference inference/config.py DeepSpeedTPConfig."""
+
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+class InferenceCheckpointConfig(DeepSpeedConfigModel):
+    checkpoint_dir: Optional[str] = None
+    save_mp_checkpoint_path: Optional[str] = None
+    base_dir: Optional[str] = None
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    kernel_inject: bool = Field(False, alias="replace_with_kernel_inject")
+    dtype: str = "bfloat16"  # float32 | float16 | bfloat16 | int8
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    enable_cuda_graph: bool = False      # accepted, no-op on TPU
+    use_triton: bool = False             # accepted, no-op on TPU
+    triton_autotune: bool = False        # accepted, no-op on TPU
+    zero: Dict = Field(default_factory=dict)
+    checkpoint: Union[str, Dict, None] = None
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = 1
+    max_batch_size: Optional[int] = None
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict] = None
+    return_tuple: bool = True
+    # sampling defaults for generate()
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    @property
+    def mp_size(self) -> int:
+        return self.tensor_parallel.tp_size
+
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+
+        return {"float32": jnp.float32, "fp32": jnp.float32,
+                "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "int8": jnp.bfloat16}[str(self.dtype).replace("torch.", "")]

@@ -112,9 +112,22 @@ class CausalSelfAttention(nn.Module):
             from ..parallel.mesh import get_model_parallel_world_size
 
             head_axes = MODEL_AXIS if get_model_parallel_world_size() > 1 else None
-            sp_fn = ring_attention if cfg.sequence_parallel == "ring" \
-                else ulysses_attention
-            y = sp_fn(q, k, v, causal=True, head_axes=head_axes)
+            if cfg.sequence_parallel == "ring":
+                if cfg.use_flash_attention:
+                    raise ValueError(
+                        "sequence_parallel='ring' computes its own blockwise "
+                        "softmax; use_flash_attention only composes with "
+                        "'ulysses'")
+                y = ring_attention(q, k, v, causal=True, head_axes=head_axes)
+            else:
+                attn_fn = None
+                if cfg.use_flash_attention:
+                    from ..ops.attention.flash_attention import flash_attention
+
+                    def attn_fn(q, k, v, *, causal, scale):
+                        return flash_attention(q, k, v, causal=causal, scale=scale)
+                y = ulysses_attention(q, k, v, causal=True, head_axes=head_axes,
+                                      attn_fn=attn_fn)
         elif cfg.use_flash_attention:
             if cfg.dropout > 0:
                 raise ValueError(

@@ -1,0 +1,370 @@
+"""Unified decoder-only transformer covering the reference's model families.
+
+The reference ships 17 per-model injection "policies/containers"
+(``deepspeed/module_inject/containers/``: gpt2, gptj, gptneo(x), llama, opt,
+bloom, megatron, bert, ...) plus fused inference modules
+(``model_implementations/transformers/ds_transformer.py:19`` and the
+``ds_bloom/ds_gpt/ds_opt/ds_megatron_gpt`` variants). TPU-native, those
+collapse into ONE parameterized flax module: every family is a point in a
+small feature space (position encoding × norm × activation × residual
+topology × GQA), and XLA fuses what the reference hand-fused in CUDA.
+
+Families are presets of :class:`TransformerConfig` (see ``FAMILY_PRESETS``):
+
+=============  ========  =========  ========  ===================
+family         pos_emb   norm       act       notes
+=============  ========  =========  ========  ===================
+gpt2           learned   layernorm  gelu      tied head, qkv bias
+gpt-neo        learned   layernorm  gelu      local attn ignored
+gptj           rotary    layernorm  gelu      parallel residual
+gpt-neox       rotary    layernorm  gelu      parallel residual, rotary_pct
+llama          rotary    rmsnorm    swiglu    no biases, untied head, GQA
+opt            learned   layernorm  relu      tied head
+bloom          alibi     layernorm  gelu      embedding layernorm
+megatron-gpt   learned   layernorm  gelu
+=============  ========  =========  ========  ===================
+
+KV-cache decoding uses the flax ``cache`` variable collection: ``prefill``
+writes the prompt's K/V at positions [0, T), ``decode`` appends one position
+via ``lax.dynamic_update_slice`` and attends over the static-shape cache with
+a validity mask — static shapes keep XLA happy (the reference's
+inference_context.h workspace is the moral equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 2048
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: Optional[int] = None     # < n_head ⇒ grouped-query attention
+    pos_emb: str = "learned"            # learned | rotary | alibi | none
+    rotary_pct: float = 1.0             # fraction of head_dim rotated (neox)
+    rope_theta: float = 10000.0
+    norm: str = "layernorm"             # layernorm | rmsnorm
+    activation: str = "gelu"            # gelu | relu | swiglu
+    mlp_ratio: float = 4.0
+    parallel_residual: bool = False     # gptj/neox: x + attn(ln1 x) + mlp(ln2 x)
+    qkv_bias: bool = True
+    mlp_bias: bool = True
+    embed_layernorm: bool = False       # bloom
+    tie_word_embeddings: bool = True
+    layer_norm_epsilon: float = 1e-5
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
+
+
+FAMILY_PRESETS = {
+    "gpt2": dict(pos_emb="learned", norm="layernorm", activation="gelu"),
+    "gpt-neo": dict(pos_emb="learned", norm="layernorm", activation="gelu"),
+    "gptj": dict(pos_emb="rotary", norm="layernorm", activation="gelu",
+                 parallel_residual=True, tie_word_embeddings=False),
+    "gpt-neox": dict(pos_emb="rotary", rotary_pct=0.25, norm="layernorm",
+                     activation="gelu", parallel_residual=True,
+                     tie_word_embeddings=False),
+    "llama": dict(pos_emb="rotary", norm="rmsnorm", activation="swiglu",
+                  qkv_bias=False, mlp_bias=False, tie_word_embeddings=False,
+                  layer_norm_epsilon=1e-6),
+    "opt": dict(pos_emb="learned", norm="layernorm", activation="relu"),
+    "bloom": dict(pos_emb="alibi", norm="layernorm", activation="gelu",
+                  embed_layernorm=True),
+    "megatron-gpt": dict(pos_emb="learned", norm="layernorm", activation="gelu"),
+}
+
+
+def transformer_config(family: str, **overrides) -> TransformerConfig:
+    """Build a config from a family preset (≅ picking an injection policy,
+    reference module_inject/replace_policy.py)."""
+    if family not in FAMILY_PRESETS:
+        raise ValueError(f"unknown family {family!r}; know {sorted(FAMILY_PRESETS)}")
+    return TransformerConfig(**{**FAMILY_PRESETS[family], **overrides})
+
+
+def transformer_sharding_rules():
+    """Megatron-style TP rules for this module's parameter paths — the
+    AutoTP analog (reference module_inject/auto_tp.py:13): column-parallel
+    up-projections, row-parallel down-projections, vocab-parallel embedding.
+    Works for every family preset (paths are family-invariant). Scanned
+    blocks carry a leading layer dim."""
+    M = MODEL_AXIS
+    return [
+        (r"embed_tokens/embedding", (M, None)),
+        (r"embed_pos/embedding", (None, None)),
+        (r"attn/(q_proj|k_proj|v_proj)/kernel", (None, None, M)),
+        (r"attn/o_proj/kernel", (None, M, None)),
+        (r"attn/(q_proj|k_proj|v_proj)/bias", (None, M)),
+        (r"mlp/(up_proj|gate_proj)/kernel", (None, None, M)),
+        (r"mlp/(up_proj|gate_proj)/bias", (None, M)),
+        (r"mlp/down_proj/kernel", (None, M, None)),
+        (r"lm_head/kernel", (None, M)),
+    ]
+
+
+def _norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name=name)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(x, positions, *, rotary_dim: int, theta: float):
+    """NeoX-style rotary embedding on the first ``rotary_dim`` channels.
+    x: (B, T, H, D); positions: (B, T) absolute token positions."""
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32)
+                                / rotary_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B,T,rd/2)
+    angles = jnp.concatenate([angles, angles], axis=-1)[:, :, None, :]  # (B,T,1,rd)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    rot32 = rot.astype(jnp.float32)
+    out = rot32 * cos + _rotate_half(rot32) * sin
+    return jnp.concatenate([out.astype(x.dtype), rest], axis=-1)
+
+
+def alibi_slopes(n_head: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (Press et al.), matching the reference's alibi
+    computation used for bloom (csrc attention alibi path)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_head).is_integer():
+        return jnp.asarray(pow2_slopes(n_head), jnp.float32)
+    closest = 2 ** math.floor(math.log2(n_head))
+    base = pow2_slopes(closest)
+    extra = pow2_slopes(2 * closest)[0::2][: n_head - closest]
+    return jnp.asarray(base + extra, jnp.float32)
+
+
+class CachedAttention(nn.Module):
+    """Multi-head / grouped-query attention with optional KV cache.
+
+    Modes:
+      - training / no-cache forward: full causal self-attention.
+      - ``decode=True``: reads+updates the ``cache`` collection
+        (k, v, cache_index); supports multi-token prefill and 1-token decode.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, decode: bool = False, deterministic: bool = True):
+        cfg = self.config
+        B, T, C = x.shape
+        H, KV, D = cfg.n_head, cfg.kv_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=cfg.qkv_bias, dtype=cfg.dtype, name=name)
+        q = dense(H * D, "q_proj")(x).reshape(B, T, H, D)
+        k = dense(KV * D, "k_proj")(x).reshape(B, T, KV, D)
+        v = dense(KV * D, "v_proj")(x).reshape(B, T, KV, D)
+
+        if decode:
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (B, cfg.max_seq_len, KV, D), cfg.dtype)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (B, cfg.max_seq_len, KV, D), cfg.dtype)
+            cidx = self.variable("cache", "index",
+                                 lambda: jnp.zeros((), jnp.int32))
+            start = cidx.value
+            positions = start + jnp.arange(T)[None, :]
+        else:
+            start = jnp.zeros((), jnp.int32)
+            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+
+        if cfg.pos_emb == "rotary":
+            rd = int(cfg.rotary_pct * D) // 2 * 2
+            q = apply_rotary(q, positions, rotary_dim=rd, theta=cfg.rope_theta)
+            k = apply_rotary(k, positions, rotary_dim=rd, theta=cfg.rope_theta)
+
+        if decode:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, start, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, start, 0, 0))
+            cidx.value = start + T
+            k_all, v_all = ck.value, cv.value
+            S = cfg.max_seq_len
+            # row t may see cache slots [0, start+t]
+            mask = (jnp.arange(S)[None, :] <= (start + jnp.arange(T))[:, None])
+        else:
+            k_all, v_all = k, v
+            S = T
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+
+        if KV != H:
+            rep = H // KV
+            k_all = jnp.repeat(k_all, rep, axis=2)
+            v_all = jnp.repeat(v_all, rep, axis=2)
+
+        scale = 1.0 / math.sqrt(D)
+        att = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                         k_all.astype(jnp.float32)) * scale
+        if cfg.pos_emb == "alibi":
+            slopes = alibi_slopes(H)  # (H,)
+            kpos = jnp.arange(S)[None, :]
+            qpos = (start + jnp.arange(T))[:, None]
+            att = att + slopes[None, :, None, None] * (kpos - qpos)[None, None]
+        att = jnp.where(mask[None, None], att, -1e30)
+        att = jax.nn.softmax(att, axis=-1)
+        if cfg.dropout > 0:
+            att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+        y = jnp.einsum("bhts,bshd->bthd", att,
+                       v_all.astype(jnp.float32)).astype(cfg.dtype)
+        y = y.reshape(B, T, H * D)
+        return nn.Dense(C, use_bias=cfg.qkv_bias, dtype=cfg.dtype, name="o_proj")(y)
+
+
+class TransformerMLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        hidden = int(cfg.mlp_ratio * cfg.n_embd)
+        if cfg.activation == "swiglu":
+            # llama sizing: 2/3 * 4d rounded — callers control via mlp_ratio
+            gate = nn.Dense(hidden, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                            name="gate_proj")(x)
+            up = nn.Dense(hidden, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                          name="up_proj")(x)
+            h = jax.nn.silu(gate) * up
+        else:
+            h = nn.Dense(hidden, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                         name="up_proj")(x)
+            h = jax.nn.gelu(h, approximate=True) if cfg.activation == "gelu" \
+                else jax.nn.relu(h)
+        h = nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                     name="down_proj")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class TransformerBlock(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, decode: bool = False, deterministic: bool = True):
+        cfg = self.config
+        a = CachedAttention(cfg, name="attn")(
+            _norm(cfg, "ln_1")(x), decode=decode, deterministic=deterministic)
+        if cfg.parallel_residual:
+            m = TransformerMLP(cfg, name="mlp")(_norm(cfg, "ln_2")(x), deterministic)
+            return x + a + m
+        x = x + a
+        m = TransformerMLP(cfg, name="mlp")(_norm(cfg, "ln_2")(x), deterministic)
+        return x + m
+
+
+class _ScanBlock(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, decode, deterministic):
+        cls = TransformerBlock
+        if self.config.remat:
+            cls = nn.remat(cls, prevent_cse=False, static_argnums=(2, 3))
+        x = cls(self.config, name="block")(x, decode, deterministic)
+        return x, None
+
+
+class TransformerLM(nn.Module):
+    """Causal LM over any family preset. Training convention matches the
+    engine (``__call__(batch) -> loss``); inference uses ``prefill``/
+    ``decode`` with the ``cache`` collection."""
+
+    config: TransformerConfig
+
+    def setup(self):
+        cfg = self.config
+        self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
+                                     name="embed_tokens")
+        if cfg.pos_emb == "learned":
+            self.embed_pos = nn.Embed(cfg.max_seq_len, cfg.n_embd, dtype=cfg.dtype,
+                                      name="embed_pos")
+        if cfg.embed_layernorm:
+            self.embed_ln = _norm(cfg, "embed_ln")
+        self.blocks = nn.scan(
+            _ScanBlock,
+            variable_axes={"params": 0, "cache": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.n_layer,
+            in_axes=(nn.broadcast, nn.broadcast),
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, name="blocks")
+        self.ln_f = _norm(cfg, "ln_f")
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                    dtype=jnp.float32, name="lm_head")
+
+    def _transform(self, input_ids, positions, decode, deterministic):
+        cfg = self.config
+        x = self.embed_tokens(input_ids)
+        if cfg.pos_emb == "learned":
+            x = x + self.embed_pos(positions)
+        if cfg.embed_layernorm:
+            x = self.embed_ln(x)
+        x, _ = self.blocks(x, decode, deterministic)
+        x = self.ln_f(x)
+        if cfg.tie_word_embeddings:
+            return self.embed_tokens.attend(x.astype(jnp.float32))
+        return self.lm_head(x.astype(jnp.float32))
+
+    def logits(self, input_ids, deterministic: bool = True):
+        B, T = input_ids.shape
+        pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        return self._transform(input_ids, pos, False, deterministic)
+
+    def prefill(self, input_ids):
+        """Run the prompt, filling the KV cache. Call with
+        ``mutable=["cache"]``. Returns (B, T, V) logits."""
+        B, T = input_ids.shape
+        pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        return self._transform(input_ids, pos, True, True)
+
+    def decode(self, input_ids, start_pos):
+        """One (or few) token step against the cache; ``start_pos`` is the
+        current cache length (B-uniform). Call with ``mutable=["cache"]``."""
+        B, T = input_ids.shape
+        pos = start_pos + jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        return self._transform(input_ids, pos, True, True)
+
+    def __call__(self, batch, deterministic: bool = False):
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels", input_ids) if hasattr(batch, "get") \
+            else input_ids
+        logits = self.logits(input_ids, deterministic)
+        logits = logits[:, :-1]
+        targets = labels[:, 1:]
+        mask = (targets >= 0).astype(jnp.float32)
+        targets = jnp.maximum(targets, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
