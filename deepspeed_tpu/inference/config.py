@@ -65,7 +65,19 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     def jnp_dtype(self):
         import jax.numpy as jnp
 
-        return {"float32": jnp.float32, "fp32": jnp.float32,
-                "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
-                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
-                "int8": jnp.bfloat16}[str(self.dtype).replace("torch.", "")]
+        from ..utils.logging import logger
+
+        table = {"float32": jnp.float32, "fp32": jnp.float32,
+                 "float16": jnp.float16, "fp16": jnp.float16,
+                 "half": jnp.float16,
+                 "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                 "int8": jnp.bfloat16}
+        key = str(self.dtype).replace("torch.", "")
+        if key not in table:
+            raise ValueError(f"unsupported inference dtype {self.dtype!r}; "
+                             f"supported: {sorted(table)}")
+        if key == "int8":
+            logger.warning(
+                "dtype=int8: weight quantization tier not wired into the "
+                "inference engine yet — compute runs in bfloat16")
+        return table[key]

@@ -10,8 +10,9 @@ workspace (inference_context.h) — maps to:
 * CUDA graphs → whole-step ``jax.jit`` (always on; ``enable_cuda_graph``
   accepted and ignored);
 * KV cache → the model's flax ``cache`` collection, statically shaped at
-  ``max_out_tokens``, donated through the decode step so updates are
-  in-place in HBM.
+  the model's ``max_seq_len``, donated through the decode step so updates
+  are in-place in HBM (``max_out_tokens`` is accepted for config
+  compatibility; capacity is the model's, and generate() enforces it).
 
 ``generate()`` runs a jitted prefill then a jitted single-token decode loop
 with greedy/temperature/top-k/top-p sampling.
@@ -160,6 +161,8 @@ class InferenceEngine:
         """Full-context logits (≅ reference engine.forward,
         inference/engine.py:592)."""
         input_ids = jnp.asarray(input_ids)
+        if input_ids.ndim == 1:
+            input_ids = input_ids[None]
         self._ensure_params(input_ids)
         return self._jit_logits(self.params, input_ids)
 

@@ -1,0 +1,120 @@
+"""DeepSpeedCPUAdam — host-side Adam over offloaded optimizer state
+(≅ reference ``ops/adam/cpu_adam.py:13``, kernel csrc/adam/cpu_adam.cpp).
+
+Operates in place on flat fp32 numpy views of (master, exp_avg, exp_avg_sq),
+one call per parameter leaf; the native library parallelizes/vectorizes.
+Falls back to a numpy implementation when the native build is unavailable
+(``DS_SKIP_NATIVE_BUILD=1`` or no toolchain) — same numerics, slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    def __init__(self, lr: float = 1e-3, betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True, bias_correction: bool = True):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self._lib: Optional[ctypes.CDLL] = None
+        try:
+            self._lib = CPUAdamBuilder().load()
+        except Exception:
+            self._lib = None  # numpy fallback
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def step(self, param: np.ndarray, grad: np.ndarray, exp_avg: np.ndarray,
+             exp_avg_sq: np.ndarray, step_num: int,
+             lr: Optional[float] = None) -> None:
+        """One Adam step, in place. All arrays: contiguous fp32, same size.
+        ``step_num`` is 1-indexed."""
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step_num
+            bc2 = 1.0 - b2 ** step_num
+        else:
+            bc1 = bc2 = 1.0
+        if self._lib is not None:
+            self._lib.ds_adam_step(
+                _f32p(param), _f32p(grad), _f32p(exp_avg), _f32p(exp_avg_sq),
+                param.size, lr, b1, b2, self.eps, self.weight_decay,
+                int(self.adamw_mode), bc1, bc2)
+            return
+        # numpy fallback (same math as the kernel)
+        g = grad
+        if self.weight_decay != 0.0 and not self.adamw_mode:
+            g = g + self.weight_decay * param
+        exp_avg *= b1
+        exp_avg += (1 - b1) * g
+        exp_avg_sq *= b2
+        exp_avg_sq += (1 - b2) * g * g
+        denom = np.sqrt(exp_avg_sq) / np.sqrt(bc2) + self.eps
+        update = (exp_avg / bc1) / denom
+        if self.weight_decay != 0.0 and self.adamw_mode:
+            update = update + self.weight_decay * param
+        param -= lr * update
+
+    def has_overflow(self, grad: np.ndarray) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.ds_has_nonfinite(_f32p(grad), grad.size))
+        return not np.isfinite(grad).all()
+
+    def to_bf16(self, src: np.ndarray, dst: Optional[np.ndarray] = None) -> np.ndarray:
+        """Round-to-nearest-even fp32→bf16; returns a uint16-backed view
+        suitable for jnp.asarray(..., dtype=bfloat16) via ml_dtypes."""
+        import ml_dtypes
+
+        if self._lib is not None:
+            if dst is None:
+                dst = np.empty(src.shape, np.uint16)
+            self._lib.ds_f32_to_bf16(
+                dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                _f32p(src), src.size)
+            return dst.view(ml_dtypes.bfloat16)
+        return src.astype(ml_dtypes.bfloat16)
+
+
+class DeepSpeedCPUAdagrad:
+    """≅ reference ops/adagrad/cpu_adagrad.py:11."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-8,
+                 weight_decay: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        try:
+            self._lib = CPUAdamBuilder().load()
+        except Exception:
+            self._lib = None
+
+    def step(self, param: np.ndarray, grad: np.ndarray, accum: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        if self._lib is not None:
+            self._lib.ds_adagrad_step(_f32p(param), _f32p(grad), _f32p(accum),
+                                      param.size, lr, self.eps, self.weight_decay)
+            return
+        g = grad
+        if self.weight_decay != 0.0:
+            g = g + self.weight_decay * param
+        accum += g * g
+        param -= lr * g / (np.sqrt(accum) + self.eps)

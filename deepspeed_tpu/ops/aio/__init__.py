@@ -1,0 +1,63 @@
+"""Async file I/O handle (≅ reference ``csrc/aio/py_lib/deepspeed_py_aio_
+handle.cpp`` API: async_pread/async_pwrite/wait), ctypes-bound.
+
+Used by the NVMe offload tier (``runtime/zero/offload.py``) to swap
+optimizer-state / parameter buffers against local SSD with overlapped I/O.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder import AsyncIOBuilder
+
+
+class AioHandle:
+    """Thread-pool async file I/O. numpy-array in/out, byte offsets."""
+
+    def __init__(self, num_threads: int = 4):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.ds_aio_create(num_threads)
+        self._refs = []  # keep submitted buffers alive until wait()
+
+    def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> None:
+        a = np.ascontiguousarray(array)
+        self._refs.append(a)
+        self._lib.ds_aio_pwrite(self._h, os.fsencode(path),
+                                a.ctypes.data, a.nbytes, offset)
+
+    def async_pread(self, array: np.ndarray, path: str, offset: int = 0) -> None:
+        assert array.flags["C_CONTIGUOUS"] and array.flags["WRITEABLE"]
+        self._refs.append(array)
+        self._lib.ds_aio_pread(self._h, os.fsencode(path),
+                               array.ctypes.data, array.nbytes, offset)
+
+    def wait(self) -> int:
+        """Blocks until all pending requests finish; returns the number of
+        FAILED requests (0 = success), raising on failure."""
+        errors = self._lib.ds_aio_wait(self._h)
+        self._refs.clear()
+        if errors:
+            raise IOError(f"aio: {errors} request(s) failed")
+        return 0
+
+    def pending(self) -> int:
+        return self._lib.ds_aio_pending(self._h)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.ds_aio_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def aio_available() -> bool:
+    return AsyncIOBuilder().is_compatible()

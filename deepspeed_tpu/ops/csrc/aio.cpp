@@ -1,0 +1,153 @@
+// Async file I/O for the NVMe offload tier (ZeRO-Infinity swap).
+//
+// TPU-native equivalent of the reference's csrc/aio/ library: a worker
+// thread pool draining a request queue of pread/pwrite jobs against local
+// SSD, with a wait() barrier — the same handle contract as
+// deepspeed_aio_thread_t (csrc/aio/py_lib/deepspeed_aio_thread.h:41) and
+// deepspeed_py_aio_handle (async_pread/async_pwrite/wait). Plain
+// pread64/pwrite64 on buffered fds instead of libaio+O_DIRECT: TPU-VM local
+// SSD sustains its bandwidth through the page cache, and the queue-depth
+// parallelism comes from the thread count.
+//
+// C ABI, ctypes-bound.
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Request {
+  bool write;
+  std::string path;
+  void* buf;
+  int64_t nbytes;
+  int64_t offset;
+};
+
+struct Handle {
+  std::vector<std::thread> workers;
+  std::deque<Request> queue;
+  std::mutex mu;
+  std::condition_variable cv_work;
+  std::condition_variable cv_done;
+  int64_t inflight = 0;
+  int64_t completed = 0;
+  std::atomic<int64_t> errors{0};
+  bool shutdown = false;
+
+  void worker_loop() {
+    for (;;) {
+      Request req;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv_work.wait(lock, [&] { return shutdown || !queue.empty(); });
+        if (shutdown && queue.empty()) return;
+        req = std::move(queue.front());
+        queue.pop_front();
+      }
+      if (!run_one(req)) errors.fetch_add(1);
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        --inflight;
+        ++completed;
+        if (inflight == 0) cv_done.notify_all();
+      }
+    }
+  }
+
+  static bool run_one(const Request& req) {
+    int flags = req.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+    int fd = ::open(req.path.c_str(), flags, 0644);
+    if (fd < 0) return false;
+    char* p = static_cast<char*>(req.buf);
+    int64_t left = req.nbytes;
+    int64_t off = req.offset;
+    bool ok = true;
+    while (left > 0) {
+      ssize_t r = req.write ? ::pwrite64(fd, p, left, off)
+                            : ::pread64(fd, p, left, off);
+      if (r <= 0) {
+        ok = false;
+        break;
+      }
+      p += r;
+      off += r;
+      left -= r;
+    }
+    ::close(fd);
+    return ok;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_create(int num_threads) {
+  auto* h = new Handle();
+  if (num_threads < 1) num_threads = 1;
+  for (int i = 0; i < num_threads; ++i)
+    h->workers.emplace_back([h] { h->worker_loop(); });
+  return h;
+}
+
+void ds_aio_destroy(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  {
+    std::unique_lock<std::mutex> lock(h->mu);
+    h->shutdown = true;
+  }
+  h->cv_work.notify_all();
+  for (auto& t : h->workers) t.join();
+  delete h;
+}
+
+static void submit(Handle* h, bool write, const char* path, void* buf,
+                   int64_t nbytes, int64_t offset) {
+  {
+    std::unique_lock<std::mutex> lock(h->mu);
+    h->queue.push_back(Request{write, path, buf, nbytes, offset});
+    ++h->inflight;
+  }
+  h->cv_work.notify_one();
+}
+
+void ds_aio_pread(void* handle, const char* path, void* buf, int64_t nbytes,
+                  int64_t offset) {
+  submit(static_cast<Handle*>(handle), false, path, buf, nbytes, offset);
+}
+
+void ds_aio_pwrite(void* handle, const char* path, const void* buf,
+                   int64_t nbytes, int64_t offset) {
+  submit(static_cast<Handle*>(handle), true, path, const_cast<void*>(buf),
+         nbytes, offset);
+}
+
+// Blocks until all submitted requests complete. Returns the number of
+// failed requests since the last wait (0 = success).
+int64_t ds_aio_wait(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  std::unique_lock<std::mutex> lock(h->mu);
+  h->cv_done.wait(lock, [&] { return h->inflight == 0; });
+  return h->errors.exchange(0);
+}
+
+int64_t ds_aio_pending(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  std::unique_lock<std::mutex> lock(h->mu);
+  return h->inflight;
+}
+
+}  // extern "C"

@@ -123,6 +123,25 @@ class DeepSpeedEngine:
         self.zero_config = self._config.zero_optimization
         self.policy = ZeroShardingPolicy(self.zero_config, self.mesh, sharding_rules)
 
+        # --- optimizer-state offload (ZeRO-Offload / Infinity) ------------
+        from .zero.offload_config import OffloadDeviceEnum
+
+        oo = self.zero_config.offload_optimizer
+        self._offload_enabled = oo is not None and \
+            oo.device != OffloadDeviceEnum.none
+        self._offload_cfg = oo
+        self._offload_opt = None
+        self._jit_offload_grads = None
+        self._jit_offload_apply = None
+        if self._offload_enabled:
+            opt_type = (self._config.optimizer.type
+                        if self._config.optimizer else "adam").lower()
+            if opt_type not in ("adam", "adamw", "cpuadam"):
+                # the reference likewise restricts CPU offload to (CPU)Adam
+                raise ValueError(
+                    f"offload_optimizer requires Adam/AdamW (got "
+                    f"{opt_type!r}); the host step runs DeepSpeedCPUAdam")
+
         # --- optimizer + schedule ------------------------------------------
         opt_cfg = self._config.optimizer
         self.optimizer_def: OptimizerDef = get_optimizer(
@@ -277,15 +296,32 @@ class DeepSpeedEngine:
                 else p
 
         params = jax.tree_util.tree_map(cast, params_host)
-        master = jax.tree_util.tree_map(
-            lambda p: jnp.asarray(p, jnp.float32) if jnp.issubdtype(
-                jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
-            params_host) if self._keep_master else None
-        opt_state = self.optimizer_def.init(master if master is not None else params)
+        if self._offload_enabled:
+            # fp32 master + moments live on HOST (numpy) inside the offload
+            # manager; the device state holds compute params only
+            from .zero.offload import OffloadedOptimizer
+
+            opt_cfg = self._config.optimizer
+            opt_params = dict(opt_cfg.params if opt_cfg else {})
+            opt_params.setdefault("lr", self._base_lr)
+            self._offload_opt = OffloadedOptimizer(
+                jax.device_get(jax.tree_util.tree_map(
+                    lambda p: np.asarray(p), params_host)),
+                opt_params, self._offload_cfg)
+            master = None
+            opt_state = None
+        else:
+            master = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, jnp.float32) if jnp.issubdtype(
+                    jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
+                params_host) if self._keep_master else None
+            opt_state = self.optimizer_def.init(master if master is not None else params)
 
         param_sh = policy.param_shardings(params)
         master_sh = policy.master_shardings(master) if master is not None else None
-        opt_sh = policy.opt_state_shardings(opt_state, master if master is not None else params)
+        opt_sh = policy.opt_state_shardings(opt_state, master if master is not None
+                                            else params) \
+            if opt_state is not None else None
         rep = _replicated(mesh)
 
         scale_state = None
@@ -300,7 +336,8 @@ class DeepSpeedEngine:
         state = {
             "params": jax.device_put(params, param_sh),
             "master": jax.device_put(master, master_sh) if master is not None else None,
-            "opt_state": jax.device_put(opt_state, opt_sh),
+            "opt_state": jax.device_put(opt_state, opt_sh)
+            if opt_state is not None else None,
             "step": jnp.asarray(0, jnp.int32),
             "opt_step": jnp.asarray(0, jnp.int32),
             "scale": scale_state,
@@ -379,32 +416,22 @@ class DeepSpeedEngine:
             grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
             return loss, grads
 
-        def update_from_grads(state, grads_sum, n_micros):
-            """Unscale, clip, step, recast — shared by fused & eager paths."""
+        def finalize_grads(state, grads_sum, denom):
+            """Unscale, clip, overflow & loss-scale/step bookkeeping — shared
+            by the fused device step and the offload path (where the fp32
+            grads then travel to host for the CPU-Adam step, ≅
+            stage_1_and_2.py:1037's CPU-offload grad copy)."""
             scale = scale_value(state)
-            denom = scale * n_micros
+            d = scale * denom
             if prescale and predivide != 1.0:
-                denom = scale * predivide
+                d = scale * predivide
             grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / denom), grads_sum)
-
+                lambda g: g.astype(jnp.float32) / d, grads_sum)
             overflow = has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
             norm = global_grad_norm(grads)
             if clip > 0:
                 grads, _ = clip_grads_by_global_norm(grads, clip, norm)
-
-            master = state["master"] if keep_master else state["params"]
-            lr = lr_fn(state["step"])
-            new_master, new_opt = opt.update(grads, state["opt_state"], master, lr,
-                                             state["opt_step"])
-
-            def pick(new, old):
-                return jax.tree_util.tree_map(
-                    lambda n, o: jnp.where(overflow, o, n), new, old)
-
             if fp16:
-                new_master = pick(new_master, master)
-                new_opt = pick(new_opt, state["opt_state"])
                 new_scale = update_scale(
                     state["scale"], overflow,
                     scale_window=fp16_cfg.loss_scale_window,
@@ -414,6 +441,31 @@ class DeepSpeedEngine:
                     new_scale = state["scale"]  # static scaling
             else:
                 new_scale = state["scale"]
+            new_state = dict(state)
+            new_state["step"] = state["step"] + 1
+            new_state["opt_step"] = state["opt_step"] + \
+                jnp.where(overflow, 0, 1).astype(jnp.int32)
+            new_state["scale"] = new_scale
+            metrics = {"overflow": overflow, "grad_norm": norm,
+                       "lr": lr_fn(state["step"]), "loss_scale": scale}
+            return new_state, grads, metrics
+
+        def update_from_grads(state, grads_sum, n_micros):
+            """finalize + on-device optimizer step + recast — the fused and
+            eager (non-offload) paths."""
+            new_state, grads, metrics = finalize_grads(state, grads_sum, n_micros)
+            overflow = metrics["overflow"]
+            master = state["master"] if keep_master else state["params"]
+            new_master, new_opt = opt.update(grads, state["opt_state"], master,
+                                             metrics["lr"], state["opt_step"])
+
+            def pick(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(overflow, o, n), new, old)
+
+            if fp16:
+                new_master = pick(new_master, master)
+                new_opt = pick(new_opt, state["opt_state"])
 
             if keep_master:
                 # recast master → compute dtype; constrain to the param specs
@@ -426,24 +478,18 @@ class DeepSpeedEngine:
             else:
                 new_params = new_master
 
-            new_state = {
-                "params": new_params,
-                "master": new_master if keep_master else None,
-                "opt_state": new_opt,
-                "step": state["step"] + 1,
-                "opt_step": state["opt_step"] + jnp.where(overflow, 0, 1).astype(jnp.int32),
-                "scale": new_scale,
-                "rng": state["rng"],
-            }
-            metrics = {
-                "overflow": overflow,
-                "grad_norm": norm,
-                "lr": lr,
-                "loss_scale": scale,
-            }
+            new_state["params"] = new_params
+            new_state["master"] = new_master if keep_master else None
+            new_state["opt_state"] = new_opt
             return new_state, metrics
 
         grads_fn = self._make_grads_fn(micro_grads, constrain_grads, scale_value, gas)
+
+        def offload_train_batch(state, stacked_batch):
+            loss, grads_sum, denom = grads_fn(state, stacked_batch)
+            new_state, grads, metrics = finalize_grads(state, grads_sum, denom)
+            metrics["loss"] = loss
+            return new_state, grads, metrics
 
         def fused_train_batch(state, stacked_batch):
             """One global step: grads over gas micro-batches + update."""
@@ -460,13 +506,22 @@ class DeepSpeedEngine:
             return loss, grads
 
         state_sh = self._shardings
+        self._jit_micro = jax.jit(one_micro)
+        self._jit_accumulate = jax.jit(lambda a, g: jax.tree_util.tree_map(
+            lambda x, y: x + y, a, g))
+        if self._offload_enabled:
+            # NOTE: state is NOT donated here — params are replaced from the
+            # host after the CPU step, the rest of the state is small
+            self._jit_offload_grads = jax.jit(
+                offload_train_batch, out_shardings=(state_sh, None, None))
+            self._jit_offload_apply = jax.jit(
+                lambda state, acc, n: finalize_grads(state, acc, n),
+                static_argnums=(2,), out_shardings=(state_sh, None, None))
+            return
         donate_state = jax.jit(
             fused_train_batch, donate_argnums=(0,),
             out_shardings=(state_sh, None))
         self._jit_train_batch = donate_state
-        self._jit_micro = jax.jit(one_micro)
-        self._jit_accumulate = jax.jit(lambda a, g: jax.tree_util.tree_map(
-            lambda x, y: x + y, a, g))
         self._jit_apply = jax.jit(
             lambda state, acc, n: update_from_grads(state, acc, n),
             donate_argnums=(0,), static_argnums=(2,),
@@ -553,7 +608,12 @@ class DeepSpeedEngine:
 
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
-        self.state, metrics = self._jit_train_batch(self.state, stacked)
+        if self._offload_enabled:
+            self.state, grads_dev, metrics = self._jit_offload_grads(
+                self.state, stacked)
+            self._host_optimizer_step(grads_dev, metrics)
+        else:
+            self.state, metrics = self._jit_train_batch(self.state, stacked)
         loss = metrics["loss"]
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
@@ -562,6 +622,21 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).stop()
         self._after_step(metrics)
         return loss
+
+    def _host_optimizer_step(self, grads_dev, metrics) -> None:
+        """Host half of the offloaded step: fp32 grads → CPU Adam → new
+        compute params back to HBM."""
+        overflow = self.fp16_enabled and bool(metrics["overflow"])
+        if overflow:
+            self.skipped_steps += 1
+            return
+        grads_host = jax.device_get(grads_dev)
+        step_num = int(self.state["opt_step"])  # 1-indexed at update time
+        new_params = self._offload_opt.step(
+            grads_host, float(metrics["lr"]), step_num,
+            np.dtype(self.compute_dtype))
+        self.state["params"] = jax.device_put(new_params,
+                                              self._shardings["params"])
 
     def _after_step(self, metrics) -> None:
         self._last_grad_norm = metrics.get("grad_norm")
@@ -634,12 +709,17 @@ class DeepSpeedEngine:
         assert self._grad_acc is not None, "step() before backward()"
         self.timers(STEP_GLOBAL_TIMER).start()
         n = float(self.gradient_accumulation_steps())
-        self.state, metrics = self._jit_apply(self.state, self._grad_acc, n)
+        if self._offload_enabled:
+            self.state, grads_dev, metrics = self._jit_offload_apply(
+                self.state, self._grad_acc, n)
+            self._host_optimizer_step(grads_dev, metrics)
+        else:
+            self.state, metrics = self._jit_apply(self.state, self._grad_acc, n)
         self._grad_acc = None
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
-        if bool(metrics["overflow"]):
-            self.skipped_steps += 1
+        if not self._offload_enabled and bool(metrics["overflow"]):
+            self.skipped_steps += 1  # offload path counts inside _host_optimizer_step
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
         metrics["loss"] = self._loss_acc / n
@@ -665,7 +745,10 @@ class DeepSpeedEngine:
             "module": fser.to_state_dict(host["params"]),
             "master": fser.to_state_dict(host["master"]) if host["master"] is not None
             else None,
-            "optimizer": fser.to_state_dict(host["opt_state"]),
+            "optimizer": fser.to_state_dict(host["opt_state"])
+            if host["opt_state"] is not None else None,
+            "offload_optimizer": self._offload_opt.state_dict()
+            if self._offload_opt is not None else None,
             "step": int(host["step"]),
             "opt_step": int(host["opt_step"]),
             "scale": fser.to_state_dict(host["scale"]) if host["scale"] is not None
@@ -723,16 +806,27 @@ class DeepSpeedEngine:
             return fser.from_state_dict(target, saved)
 
         new_state = dict(self.state)
+        restored_params = restore(host["params"], sd["module"])
         new_state["params"] = jax.device_put(
-            restore(host["params"], sd["module"]), self._shardings["params"])
+            restored_params, self._shardings["params"])
+        if self._offload_opt is not None and (
+                load_module_only or not load_optimizer_states
+                or sd.get("offload_optimizer") is None):
+            # module-only restore under offload: re-seed the host master so
+            # the next step doesn't overwrite the loaded weights
+            self._offload_opt.sync_master_from(restored_params)
         if not load_module_only:
             if sd.get("master") is not None and host["master"] is not None:
                 new_state["master"] = jax.device_put(
                     restore(host["master"], sd["master"]), self._shardings["master"])
-            if load_optimizer_states and sd.get("optimizer") is not None:
+            if load_optimizer_states and sd.get("optimizer") is not None \
+                    and host["opt_state"] is not None:
                 new_state["opt_state"] = jax.device_put(
                     restore(host["opt_state"], sd["optimizer"]),
                     self._shardings["opt_state"])
+            if load_optimizer_states and self._offload_opt is not None \
+                    and sd.get("offload_optimizer") is not None:
+                self._offload_opt.load_state_dict(sd["offload_optimizer"])
             new_state["step"] = jnp.asarray(sd["step"], jnp.int32)
             new_state["opt_step"] = jnp.asarray(sd.get("opt_step", sd["step"]), jnp.int32)
             if sd.get("scale") is not None and host["scale"] is not None:
