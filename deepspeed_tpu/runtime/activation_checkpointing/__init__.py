@@ -1,0 +1,13 @@
+from . import checkpointing  # noqa: F401
+from .checkpointing import (  # noqa: F401
+    checkpoint,
+    checkpoint_name,
+    checkpoint_sequential,
+    checkpoint_wrapper,
+    configure,
+    fold_in_model_parallel_rank,
+    get_rng_tracker,
+    is_configured,
+    model_parallel_manual_seed,
+    partition,
+)

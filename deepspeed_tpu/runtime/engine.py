@@ -174,6 +174,19 @@ class DeepSpeedEngine:
                        verbose=cl.verbose, debug=cl.debug)
         self.checkpoint_engine = ArrayCheckpointEngine()
 
+        # activation checkpointing from the JSON block (reference
+        # engine._configure_checkpointing → checkpointing.configure,
+        # checkpointing.py:789)
+        from .activation_checkpointing import checkpointing as _act_ckpt
+        from .config import ActivationCheckpointingConfig as _ActCfg
+
+        # Apply this engine's block when it says something non-default;
+        # otherwise only fill in defaults if nothing was configured yet
+        # (don't clobber an earlier explicit user configure()).
+        if (not _act_ckpt.is_configured()
+                or self._config.activation_checkpointing != _ActCfg()):
+            _act_ckpt.configure(deepspeed_config=self._config)
+
         # --- compiled-state ----------------------------------------------
         self.state: Optional[Dict[str, Any]] = None
         self._shardings: Optional[Dict[str, Any]] = None
