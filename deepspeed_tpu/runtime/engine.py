@@ -619,6 +619,7 @@ class DeepSpeedEngine:
             first = jax.tree_util.tree_map(lambda x: x[0], stacked)
             self._build_state(self._init_params_from_batch(first))
 
+        self._maybe_profile_flops(stacked)
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         if self._offload_enabled:
@@ -635,6 +636,29 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).stop()
         self._after_step(metrics)
         return loss
+
+    def _maybe_profile_flops(self, stacked_batch) -> None:
+        """Engine-integrated flops profiler at ``profile_step`` — reference
+        engine.py:1688,1705 flops_profiler hooks."""
+        fp = self._config.flops_profiler
+        if not fp.enabled or self.global_steps != fp.profile_step \
+                or getattr(self, "_flops_profiled", False):
+            return
+        self._flops_profiled = True  # once, even with gas>1 eager forwards
+        from ..profiling.flops_profiler import FlopsProfiler
+
+        loss_fn = self._loss_fn
+        micro = jax.tree_util.tree_map(lambda x: x[0], stacked_batch)
+        rng = jax.random.PRNGKey(0)
+        prof = FlopsProfiler(model=self.module, ds_engine=self)
+        prof.start_profile()
+        prof.profile(lambda p, b: loss_fn(p, b, rng), self.state["params"],
+                     micro, run=False)
+        prof.print_model_profile(
+            profile_step=self.global_steps, module_depth=fp.module_depth,
+            top_modules=fp.top_modules, detailed=fp.detailed,
+            output_file=fp.output_file)
+        prof.end_profile()
 
     def _host_optimizer_step(self, grads_dev, metrics) -> None:
         """Host half of the offloaded step: fp32 grads → CPU Adam → new
@@ -683,10 +707,12 @@ class DeepSpeedEngine:
         engine.forward (engine.py:1675)."""
         if self.state is None:
             self._build_state(self._init_params_from_batch(batch))
-        self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = jax.tree_util.tree_map(
             lambda x: jax.device_put(
                 np.asarray(x), self._batch_leaf_sharding(np.ndim(x))), batch)
+        self._maybe_profile_flops(
+            jax.tree_util.tree_map(lambda x: x[None], batch))
+        self.timers(FORWARD_GLOBAL_TIMER).start()
         loss, grads = self._jit_micro(
             self.state, batch,
             jnp.asarray(self.micro_steps % self.gradient_accumulation_steps(),

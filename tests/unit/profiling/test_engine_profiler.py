@@ -1,0 +1,35 @@
+"""Engine-integrated flops profiling (reference engine.py:1688 +
+tests/unit/inference/test_model_profiling.py analog)."""
+
+import numpy as np
+
+import deepspeed_tpu as ds
+
+
+def test_engine_profiles_at_step(tmp_path, capsys):
+    from tests.unit.simple_model import SimpleModel
+
+    out = str(tmp_path / "flops.txt")
+    model = SimpleModel(hidden_dim=32)
+    dim = 16
+    config = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "flops_profiler": {"enabled": True, "profile_step": 1,
+                           "output_file": out},
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"x": rng.standard_normal((engine.train_batch_size(), dim),
+                                         dtype=np.float32),
+                "y": rng.standard_normal((engine.train_batch_size(),),
+                                         dtype=np.float32)}
+
+    for _ in range(3):
+        engine.train_batch(batch=batch())
+    with open(out) as f:
+        report = f.read()
+    assert "Flops Profiler" in report
+    assert "FLOPs" in report
