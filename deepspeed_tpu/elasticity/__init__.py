@@ -1,0 +1,19 @@
+from .config import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+from .elastic_agent import DSElasticAgent, WorkerSpec
+from .elasticity import (
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+)
+
+__all__ = [
+    "ElasticityConfig", "ElasticityConfigError", "ElasticityError",
+    "ElasticityIncompatibleWorldSize", "DSElasticAgent", "WorkerSpec",
+    "compute_elastic_config", "elasticity_enabled",
+    "ensure_immutable_elastic_config",
+]

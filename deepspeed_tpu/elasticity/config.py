@@ -1,0 +1,94 @@
+"""Elasticity config + exceptions.
+
+Capability parity with reference ``deepspeed/elasticity/config.py`` —
+``ElasticityConfig`` holding the elastic-batch search space and the
+exception taxonomy (ElasticityError / ElasticityConfigError /
+ElasticityIncompatibleWorldSize).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+LATEST_ELASTICITY_VERSION = 0.2
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+
+
+class ElasticityError(Exception):
+    """Base exception for all elasticity related errors."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Elasticity configuration error."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size incompatible with the given elastic config."""
+
+
+class ElasticityConfig:
+    """Constructed from the ``elasticity`` JSON block:
+
+    {
+        "enabled": true,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6],
+        "min_gpus": 1,
+        "max_gpus": 10000,
+        "min_time": 20,
+        "version": 0.2,
+        "ignore_non_elastic_batch_info": false,
+        "num_gpus_per_node": 1,
+        "model_parallel_size": 1
+    }
+
+    Key names keep the reference spelling (``gpus``) so unmodified configs
+    parse; on TPU a "gpu" is a chip.
+    """
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        self.enabled = bool(param_dict.get("enabled", False))
+        if self.enabled:
+            if "max_train_batch_size" not in param_dict:
+                raise ElasticityConfigError(
+                    "Elasticity config missing max_train_batch_size")
+            if "micro_batch_sizes" not in param_dict:
+                raise ElasticityConfigError(
+                    "Elasticity config missing micro_batch_sizes")
+        self.max_acceptable_batch_size = int(
+            param_dict.get("max_train_batch_size", 0) or 0)
+        self.micro_batches: List[int] = list(
+            param_dict.get("micro_batch_sizes", []) or [])
+        if self.enabled:
+            if any(not isinstance(m, int) or m <= 0 for m in self.micro_batches):
+                raise ElasticityConfigError(
+                    f"micro_batch_sizes must be positive ints, got "
+                    f"{self.micro_batches}")
+            if self.max_acceptable_batch_size < max(self.micro_batches, default=0):
+                raise ElasticityConfigError(
+                    f"max_train_batch_size ({self.max_acceptable_batch_size}) "
+                    f"must be >= every micro batch {self.micro_batches}")
+        self.min_gpus = int(param_dict.get("min_gpus", 1))
+        self.max_gpus = int(param_dict.get("max_gpus", 10000))
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"invalid gpu range [{self.min_gpus}, {self.max_gpus}]")
+        self.min_time = int(param_dict.get("min_time", 0))
+        self.version = float(param_dict.get("version", 0.2))
+        self.ignore_non_elastic_batch_info = bool(
+            param_dict.get("ignore_non_elastic_batch_info", False))
+        self.num_gpus_per_node = int(param_dict.get("num_gpus_per_node", 1))
+        self.model_parallel_size = int(param_dict.get("model_parallel_size", 1))
+
+    def repr_dict(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "max_train_batch_size": self.max_acceptable_batch_size,
+            "micro_batch_sizes": self.micro_batches,
+            "min_gpus": self.min_gpus,
+            "max_gpus": self.max_gpus,
+            "min_time": self.min_time,
+            "version": self.version,
+            "num_gpus_per_node": self.num_gpus_per_node,
+            "model_parallel_size": self.model_parallel_size,
+        }

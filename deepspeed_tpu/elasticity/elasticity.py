@@ -1,0 +1,275 @@
+"""Elastic-batch math.
+
+Capability parity with reference ``deepspeed/elasticity/elasticity.py`` —
+``compute_elastic_config`` (:233) picks a total train batch size that is
+compatible (via gradient accumulation) with as many device counts as
+possible, so a job can be rescheduled across the allowed chip-count range
+without changing convergence behavior. v0.1 (:83) searches highly-composite
+scalings of the micro-batches; v0.2 (:126) works at node granularity with a
+fixed current DP size and model parallelism.
+
+The arithmetic is hardware-agnostic; on TPU "gpus" = chips and
+"num_gpus_per_node" = chips per host. Re-meshing after a world-size change
+is handled by the universal checkpoint (deepspeed_tpu/checkpoint/).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from functools import reduce
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+from .config import (
+    DEEPSPEED_ELASTICITY_CONFIG,
+    LATEST_ELASTICITY_VERSION,
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+
+# Highly composite numbers — maximally divisible scaling factors; enough to
+# reach ~720k batch (reference elasticity.py:21 uses the same well-known
+# integer sequence, OEIS A002182).
+HCN_LIST = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680,
+    2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360, 50400, 55440,
+    83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280,
+    720720,
+]
+
+
+def _lcm(values: List[int]) -> int:
+    return reduce(lambda a, b: a * b // math.gcd(a, b), values, 1)
+
+
+def get_candidate_batch_sizes(base_list: List[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """Each base scaled by the largest HCN keeping base*hcn <= max."""
+    candidates = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+            continue
+        limit = max_acceptable_batch_size // base
+        scale = 1
+        for hcn in HCN_LIST:
+            if hcn > limit:
+                break
+            scale = hcn
+        candidates.add(scale * base)
+    out = sorted(candidates)
+    logger.info(f"Candidate batch sizes: {out}")
+    return out
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """All world sizes w in [min, max] such that batch_size = micro * gas * w
+    for some micro in micro_batches and integer gas."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro != 0:
+            continue
+        max_gpus = batch_size // micro
+        if min_valid_gpus <= max_gpus <= max_valid_gpus:
+            valid.add(max_gpus)
+        for w in range(1, max_gpus // 2 + 1):
+            if w > max_valid_gpus:
+                break
+            if w >= min_valid_gpus and max_gpus % w == 0:
+                valid.add(w)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes: List[int],
+                        micro_batches: List[int], min_gpus: int, max_gpus: int,
+                        prefer_larger: bool) -> Tuple[int, List[int]]:
+    """Candidate with the most compatible world sizes (ties broken by
+    batch-size preference)."""
+    best_count = 0
+    best_valid: Optional[List[int]] = None
+    best_batch = int(min(micro_batches))
+    for batch_size in candidate_batch_sizes:
+        valid = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        better_tie = (prefer_larger and batch_size > best_batch) or \
+            (not prefer_larger and batch_size < best_batch)
+        if len(valid) > best_count or (len(valid) == best_count and better_tie):
+            best_count = len(valid)
+            best_valid = valid
+            best_batch = batch_size
+    return best_batch, best_valid
+
+
+def _get_compatible_gpus_v01(micro_batches: List[int],
+                             max_acceptable_batch_size: int,
+                             min_gpus: Optional[int] = None,
+                             max_gpus: Optional[int] = None,
+                             prefer_larger: bool = True):
+    """v0.1: bases = each micro batch and their LCM; scale by HCNs; pick the
+    batch compatible with the most world sizes in [min_gpus, max_gpus]."""
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(
+            f"all micro batches {micro_batches} must be <= "
+            f"max_acceptable_batch_size {max_acceptable_batch_size}")
+    base_list = list(micro_batches) + [_lcm(micro_batches)]
+    candidates = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus,
+                               prefer_larger)
+
+
+def _get_compatible_gpus_v02(micro_batches: List[int],
+                             max_acceptable_batch_size: int,
+                             current_num_gpus: int,
+                             min_gpus: Optional[int] = None,
+                             max_gpus: Optional[int] = None,
+                             prefer_larger: bool = True,
+                             num_gpus_per_node: int = 1,
+                             model_parallel_size: int = 1):
+    """v0.2: node-granular (world sizes are whole nodes), model-parallel
+    aware (DP size = chips / mp). Falls back to scaling the current DP size
+    when the v0.1 answer doesn't include it."""
+    if num_gpus_per_node % model_parallel_size != 0:
+        raise ElasticityError(
+            f"num_gpus_per_node {num_gpus_per_node} must be divisible by "
+            f"model_parallel_size {model_parallel_size}")
+
+    def get_microbatch(final_batch_size: int) -> Optional[int]:
+        candidate = None
+        for micro in micro_batches:
+            if (final_batch_size // current_num_gpus) % micro == 0:
+                if candidate is None or (prefer_larger and micro > candidate):
+                    candidate = micro
+        return candidate
+
+    dp_size_per_node = num_gpus_per_node // model_parallel_size
+    final_batch_size, valid_nodes = _get_compatible_gpus_v01(
+        micro_batches,
+        int(max_acceptable_batch_size / dp_size_per_node),
+        int((min_gpus or 1) / num_gpus_per_node) or 1,
+        int((max_gpus or current_num_gpus) / num_gpus_per_node) or 1,
+        prefer_larger=prefer_larger)
+    final_batch_size = int(final_batch_size) * dp_size_per_node
+    valid_dp_sizes = [n * dp_size_per_node for n in (valid_nodes or [])]
+    if current_num_gpus // model_parallel_size in valid_dp_sizes:
+        return final_batch_size, valid_dp_sizes, get_microbatch(final_batch_size)
+
+    # fallback: keep the current DP size, choose the largest batch under max
+    current_dp_size = (current_num_gpus // num_gpus_per_node) * dp_size_per_node
+    candidates = []
+    for micro in micro_batches:
+        min_batch = micro * current_dp_size
+        candidates.append(int(max_acceptable_batch_size // min_batch) * min_batch)
+    batch = max(candidates) if prefer_larger else min(candidates)
+    return batch, [int(current_dp_size)], get_microbatch(batch)
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict) -> None:
+    """The resource scheduler and runtime must agree on the elastic search
+    space (reference elasticity.py:208)."""
+    if DEEPSPEED_ELASTICITY_CONFIG in os.environ:
+        sched = ElasticityConfig(
+            json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+        runtime = ElasticityConfig(runtime_elastic_config_dict)
+        for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+            if getattr(runtime, field) != getattr(sched, field):
+                raise ElasticityConfigError(
+                    f"Elastic config '{field}={getattr(sched, field)}' seen by "
+                    f"resource scheduler does not match runtime "
+                    f"{field}={getattr(runtime, field)}")
+    else:
+        logger.warning(
+            f"{DEEPSPEED_ELASTICITY_CONFIG} env var not found; cannot "
+            "guarantee the resource scheduler will scale this job with "
+            "compatible chip counts")
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Compute (final_batch_size, valid_gpus[, micro_batch]) for an elastic
+    job — reference elasticity.py:233. Deterministic for a given config, so
+    the scheduler and every runtime agree.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(
+            f"expected ds_config dict, got {type(ds_config)}: {ds_config}")
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError(
+            "'elasticity' is missing from the config json")
+    elastic_config_dict = ds_config["elasticity"]
+    if not elastic_config_dict.get("enabled", False):
+        raise ElasticityConfigError(
+            "Elasticity is disabled; set elasticity.enabled=true")
+    elastic_config = ElasticityConfig(elastic_config_dict)
+
+    if elastic_config.model_parallel_size > 1 and \
+            float(elastic_config.version) != 0.2:
+        raise ElasticityConfigError(
+            f"Elasticity v{elastic_config.version} does not support "
+            f"model parallelism (size {elastic_config.model_parallel_size})")
+    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity version {elastic_config.version} > latest supported "
+            f"{LATEST_ELASTICITY_VERSION}")
+    if 'train_batch_size' in ds_config and not \
+            elastic_config.ignore_non_elastic_batch_info:
+        raise ElasticityConfigError(
+            "train_batch_size in the config conflicts with elasticity; remove "
+            "it or set elasticity.ignore_non_elastic_batch_info=true")
+
+    micro_batch = None
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_gpus = _get_compatible_gpus_v01(
+            elastic_config.micro_batches,
+            elastic_config.max_acceptable_batch_size,
+            elastic_config.min_gpus, elastic_config.max_gpus,
+            prefer_larger=True)
+    elif float(elastic_config.version) == 0.2:
+        if world_size != 0:
+            current = world_size
+        else:
+            current = int(os.environ.get("WORLD_SIZE", 0))
+        if current == 0:
+            raise ElasticityConfigError(
+                "elasticity v0.2 needs the current world size (arg or "
+                "WORLD_SIZE env)")
+        final_batch_size, valid_gpus, micro_batch = _get_compatible_gpus_v02(
+            elastic_config.micro_batches,
+            elastic_config.max_acceptable_batch_size,
+            current_num_gpus=current,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=True,
+            num_gpus_per_node=elastic_config.num_gpus_per_node,
+            model_parallel_size=elastic_config.model_parallel_size)
+    else:
+        raise ElasticityConfigError(
+            f"unknown elasticity version {elastic_config.version}")
+
+    logger.info(f"elasticity: final batch size {final_batch_size}, "
+                f"valid chip counts {valid_gpus}")
+    # v0.2 returns valid *DP* world sizes; the caller's world_size is chips
+    effective_ws = world_size // elastic_config.model_parallel_size \
+        if float(elastic_config.version) == 0.2 else world_size
+    if world_size > 0 and effective_ws not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} (dp {effective_ws}) is not compatible; "
+            f"valid counts: {valid_gpus}")
+    if return_microbatch:
+        if micro_batch is None and world_size > 0:
+            for m in sorted(elastic_config.micro_batches, reverse=True):
+                if (final_batch_size // world_size) % m == 0:
+                    micro_batch = m
+                    break
+        return final_batch_size, valid_gpus, micro_batch
+    return final_batch_size, valid_gpus

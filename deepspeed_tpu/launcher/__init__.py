@@ -1,0 +1,7 @@
+from .runner import (
+    encode_world_info,
+    fetch_hostfile,
+    parse_inclusion_exclusion,
+)
+
+__all__ = ["fetch_hostfile", "parse_inclusion_exclusion", "encode_world_info"]

@@ -1,0 +1,242 @@
+"""Job launcher CLI.
+
+Capability parity with reference ``deepspeed/launcher/runner.py:382 main()``
+— hostfile parsing (:194,207), ``--include/--exclude`` resource filtering
+(:249), base64 world-info encoding (:347), multi-node runner selection, and
+single-node fall-through to the node-local launcher. Invoke as
+``python -m deepspeed_tpu.launcher.runner`` (≅ the ``deepspeed`` CLI).
+
+Hostfile format (reference parity)::
+
+    worker-1 slots=4
+    worker-2 slots=4
+
+On TPU, ``slots`` is the number of launcher *processes* per host (1 for the
+standard one-process-per-host JAX model).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+from .multinode_runner import (
+    IMPIRunner,
+    MPICHRunner,
+    MVAPICHRunner,
+    OpenMPIRunner,
+    PDSHRunner,
+    SlurmRunner,
+)
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "XLA_FLAGS", "JAX_PLATFORMS",
+               "LD_LIBRARY_PATH", "TPU_LIBRARY_PATH"]
+PDSH_LAUNCHER = "pdsh"
+OPENMPI_LAUNCHER = "openmpi"
+MPICH_LAUNCHER = "mpich"
+IMPI_LAUNCHER = "impi"
+SLURM_LAUNCHER = "slurm"
+MVAPICH_LAUNCHER = "mvapich"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed-TPU launcher: starts a multi-host training "
+        "job from a hostfile")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile path: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="nodes/slots to include, e.g. "
+                        "'worker-1@worker-2:0,2' limits hosts and slots")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="nodes/slots to exclude, e.g. 'worker-1:0'")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="limit the number of nodes")
+    parser.add_argument("--min_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_accelerators", type=int,
+                        default=-1, dest="num_gpus",
+                        help="processes per node (TPU: usually 1/host)")
+    parser.add_argument("--master_port", default=29500, type=int)
+    parser.add_argument("--master_addr", default="", type=str)
+    parser.add_argument("--launcher", default=PDSH_LAUNCHER, type=str,
+                        help="multi-node launcher backend: pdsh, openmpi, "
+                        "mpich, impi, slurm, mvapich")
+    parser.add_argument("--launcher_args", default="", type=str)
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--autotuning", default="", choices=["", "tune", "run"],
+                        type=str, help="run the autotuner before launching")
+    parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("--max_elastic_restarts", type=int, default=3)
+    parser.add_argument("--bind_cores_to_rank", action="store_true")
+    parser.add_argument("--ssh_port", type=int, default=None)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
+    """Parse '<host> slots=<n>' lines — reference runner.py:194."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                logger.error(f"hostfile: unable to parse line: {line!r}")
+                raise ValueError(f"hostfile {hostfile_path} has a bad line: "
+                                 f"{line!r} (expected '<host> slots=<n>')")
+            if hostname in resource_pool:
+                raise ValueError(f"hostfile contains duplicate host "
+                                 f"{hostname}")
+            resource_pool[hostname] = slot_count
+    if not resource_pool:
+        return None
+    return resource_pool
+
+
+def _parse_hostfile_filter(s: str) -> Dict[str, Optional[List[int]]]:
+    """'worker-0@worker-1:0,2' → {worker-0: None, worker-1: [0, 2]}."""
+    mapping: Dict[str, Optional[List[int]]] = {}
+    for node_config in s.split("@"):
+        if not node_config:
+            continue
+        if ":" in node_config:
+            hostname, slots = node_config.split(":")
+            mapping[hostname] = [int(x) for x in slots.split(",")]
+        else:
+            mapping[node_config] = None
+    return mapping
+
+
+def parse_inclusion_exclusion(resource_pool: Dict[str, int], inclusion: str,
+                              exclusion: str) -> Dict[str, List[int]]:
+    """Apply --include/--exclude — reference runner.py:249. Returns
+    {host: [slot ids]}."""
+    active: "OrderedDict[str, List[int]]" = OrderedDict()
+    for host, slots in resource_pool.items():
+        active[host] = list(range(slots))
+
+    if inclusion:
+        included = _parse_hostfile_filter(inclusion)
+        for host in included:
+            if host not in active:
+                raise ValueError(f"include host {host} not in hostfile")
+        new_active: "OrderedDict[str, List[int]]" = OrderedDict()
+        for host, slots in included.items():
+            new_active[host] = slots if slots is not None else active[host]
+        active = new_active
+
+    if exclusion:
+        excluded = _parse_hostfile_filter(exclusion)
+        for host, slots in excluded.items():
+            if host not in active:
+                raise ValueError(f"exclude host {host} not in hostfile")
+            if slots is None:
+                del active[host]
+            else:
+                active[host] = [s for s in active[host] if s not in slots]
+                if not active[host]:
+                    del active[host]
+    return dict(active)
+
+
+def encode_world_info(world_info: Dict[str, List[int]]) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    resource_pool = fetch_hostfile(args.hostfile)
+    multi_node = resource_pool is not None and len(resource_pool) > 1
+    if not resource_pool:
+        slots = args.num_gpus if args.num_gpus > 0 else 1
+        resource_pool = {"localhost": slots}
+
+    if args.num_nodes > 0:
+        resource_pool = OrderedDict(
+            list(resource_pool.items())[:args.num_nodes])
+    if args.num_gpus > 0:
+        resource_pool = OrderedDict(
+            (h, args.num_gpus) for h in resource_pool)
+
+    active_resources = parse_inclusion_exclusion(resource_pool, args.include,
+                                                 args.exclude)
+    if not active_resources:
+        raise RuntimeError("no active resources after include/exclude")
+
+    if not args.master_addr:
+        first = list(active_resources.keys())[0]
+        args.master_addr = "127.0.0.1" if first == "localhost" else first
+
+    if args.autotuning:
+        from ..autotuning.autotuner import run_autotuning
+
+        run_autotuning(args, active_resources)
+        return
+
+    world_info_b64 = encode_world_info(active_resources)
+    env = dict(os.environ)
+
+    if not multi_node and not args.force_multi:
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={world_info_b64}", "--node_rank=0",
+               f"--master_addr={args.master_addr}",
+               f"--master_port={args.master_port}"]
+        if args.elastic_training:
+            cmd += ["--enable_elastic_training",
+                    f"--max_elastic_restarts={args.max_elastic_restarts}"]
+        cmd += [args.user_script] + args.user_args
+        logger.info(f"cmd = {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=env)
+        result.wait()
+        sys.exit(result.returncode)
+
+    # multi-node
+    if args.launcher == PDSH_LAUNCHER:
+        runner = PDSHRunner(args, world_info_b64)
+    elif args.launcher == OPENMPI_LAUNCHER:
+        runner = OpenMPIRunner(args, world_info_b64, active_resources)
+    elif args.launcher == MPICH_LAUNCHER:
+        runner = MPICHRunner(args, world_info_b64, active_resources)
+    elif args.launcher == IMPI_LAUNCHER:
+        runner = IMPIRunner(args, world_info_b64, active_resources)
+    elif args.launcher == SLURM_LAUNCHER:
+        runner = SlurmRunner(args, world_info_b64, active_resources)
+    elif args.launcher == MVAPICH_LAUNCHER:
+        runner = MVAPICHRunner(args, world_info_b64, active_resources)
+    else:
+        raise NotImplementedError(f"unknown launcher {args.launcher}")
+
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {args.launcher} not installed")
+
+    for var in EXPORT_ENVS:
+        if var in env:
+            runner.add_export(var, env[var])
+    cmd = runner.get_cmd(env, active_resources)
+    logger.info(f"cmd = {' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

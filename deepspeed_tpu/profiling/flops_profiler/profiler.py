@@ -87,14 +87,6 @@ _REDUCTIONS = {
     "reduce_or", "argmax", "argmin", "cumsum", "cumlogsumexp", "cummax",
     "cummin", "cumprod", "reduce_precision", "logsumexp",
 }
-_ZERO_COST = {
-    "reshape", "transpose", "broadcast_in_dim", "squeeze", "convert_element_type",
-    "slice", "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
-    "gather", "scatter", "scatter-add", "rev", "iota", "copy", "stop_gradient",
-    "device_put", "sharding_constraint", "split", "pjit_sharding_constraint",
-}
-
-
 def _eqn_cost(eqn) -> Tuple[int, int]:
     """Returns (macs, flops) of one jaxpr equation (non-recursive prims)."""
     name = eqn.primitive.name
@@ -143,13 +135,16 @@ def count_jaxpr_flops(jaxpr, scale: int = 1,
             # trip count unknowable statically; count body once
             subjaxprs = [eqn.params["body_jaxpr"], eqn.params["cond_jaxpr"]]
         elif name == "cond":
-            # count the most expensive branch
+            # count the most expensive branch (re-walked with the tree so
+            # its flops are attributed to scopes, not just the totals)
             branches = eqn.params.get("branches", ())
             if branches:
                 costs = [count_jaxpr_flops(b, 1) for b in branches]
-                bm, bf = max(costs, key=lambda c: c[1])
-                total_macs += scale * bm
-                total_flops += scale * bf
+                best = max(range(len(costs)), key=lambda i: costs[i][1])
+                scope = _scope_of(eqn) or prefix
+                bm, bf = count_jaxpr_flops(branches[best], scale, tree, scope)
+                total_macs += bm
+                total_flops += bf
             continue
         elif "jaxpr" in eqn.params:  # pjit/custom_jvp/custom_vjp/remat/closed_call
             subjaxprs = [eqn.params["jaxpr"]]

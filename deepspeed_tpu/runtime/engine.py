@@ -707,12 +707,12 @@ class DeepSpeedEngine:
         engine.forward (engine.py:1675)."""
         if self.state is None:
             self._build_state(self._init_params_from_batch(batch))
+        self._maybe_profile_flops(
+            jax.tree_util.tree_map(lambda x: np.asarray(x)[None], batch))
+        self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = jax.tree_util.tree_map(
             lambda x: jax.device_put(
                 np.asarray(x), self._batch_leaf_sharding(np.ndim(x))), batch)
-        self._maybe_profile_flops(
-            jax.tree_util.tree_map(lambda x: x[None], batch))
-        self.timers(FORWARD_GLOBAL_TIMER).start()
         loss, grads = self._jit_micro(
             self.state, batch,
             jnp.asarray(self.micro_steps % self.gradient_accumulation_steps(),

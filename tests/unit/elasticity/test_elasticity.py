@@ -1,0 +1,165 @@
+"""Elastic-batch math — analog of reference
+``tests/unit/elasticity/test_elastic.py``."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    DSElasticAgent,
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    WorkerSpec,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+)
+
+BASE_CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    final_batch_size, valid_gpus = compute_elastic_config(BASE_CONFIG)
+    # every valid world size divides the batch through some micro batch
+    for w in valid_gpus:
+        assert any(final_batch_size % (m * w) == 0
+                   for m in BASE_CONFIG["elasticity"]["micro_batch_sizes"]), \
+            (final_batch_size, w)
+    assert 32 <= min(valid_gpus)
+    assert max(valid_gpus) <= 1500
+    assert final_batch_size <= 10000
+
+
+def test_deterministic():
+    a = compute_elastic_config(BASE_CONFIG)
+    b = compute_elastic_config(json.loads(json.dumps(BASE_CONFIG)))
+    assert a == b
+
+
+def test_world_size_validation():
+    cfg = json.loads(json.dumps(BASE_CONFIG))
+    _, valid = compute_elastic_config(cfg)
+    ok = valid[0]
+    compute_elastic_config(cfg, world_size=ok)  # no raise
+    bad = max(valid) + 1
+    while bad in valid:
+        bad += 1
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=bad)
+
+
+def test_disabled_raises():
+    cfg = {"elasticity": {"enabled": False, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [2]}}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg)
+
+
+def test_missing_block_raises():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({})
+
+
+def test_train_batch_conflict_raises():
+    cfg = json.loads(json.dumps(BASE_CONFIG))
+    cfg["train_batch_size"] = 64
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg)
+    cfg["elasticity"]["ignore_non_elastic_batch_info"] = True
+    compute_elastic_config(cfg)  # no raise
+
+
+def test_invalid_config_values():
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "micro_batch_sizes": [2]})
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "max_train_batch_size": 4,
+                          "micro_batch_sizes": [8]})
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [0, 2]})
+
+
+def test_v02_model_parallel():
+    cfg = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2048,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 8,
+            "max_gpus": 64,
+            "version": 0.2,
+            "num_gpus_per_node": 8,
+            "model_parallel_size": 2,
+        }
+    }
+    batch, valid, micro = compute_elastic_config(cfg, world_size=16,
+                                                 return_microbatch=True)
+    assert micro in (2, 4)
+    # dp world = 16/2 = 8 must be able to consume the batch
+    assert batch % micro == 0
+
+
+def test_v01_rejects_model_parallel():
+    cfg = json.loads(json.dumps(BASE_CONFIG))
+    cfg["elasticity"]["model_parallel_size"] = 2
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg)
+
+
+def test_elasticity_enabled_helper():
+    assert elasticity_enabled(BASE_CONFIG)
+    assert not elasticity_enabled({})
+
+
+def test_immutable_config_check(monkeypatch):
+    block = BASE_CONFIG["elasticity"]
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG", json.dumps(block))
+    ensure_immutable_elastic_config(block)  # same → ok
+    changed = dict(block, max_train_batch_size=5000)
+    with pytest.raises(ElasticityConfigError):
+        ensure_immutable_elastic_config(changed)
+
+
+def test_elastic_agent_restarts(tmp_path):
+    """Worker fails twice then succeeds; agent must retry and exit 0."""
+    import sys
+    import textwrap
+
+    marker = tmp_path / "attempts"
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        p = {str(marker)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        sys.exit(0 if n >= 2 else 1)
+    """))
+    spec = WorkerSpec(entrypoint=[sys.executable, str(script)],
+                      local_world_size=1, max_restarts=3,
+                      monitor_interval=0.05)
+    agent = DSElasticAgent(spec)
+    assert agent.run() == 0
+    assert int(marker.read_text()) == 3
+
+
+def test_elastic_agent_exhausts_restarts(tmp_path):
+    import sys
+
+    spec = WorkerSpec(entrypoint=[sys.executable, "-c", "import sys; sys.exit(3)"],
+                      local_world_size=1, max_restarts=1,
+                      monitor_interval=0.05)
+    agent = DSElasticAgent(spec)
+    assert agent.run() == 3
+    assert agent.restarts == 1

@@ -1,0 +1,53 @@
+"""End-to-end single-node launch through the CLI — the analog of the
+reference's launcher integration tests."""
+
+import subprocess
+import sys
+import textwrap
+
+
+def test_single_node_launch_sets_env(tmp_path):
+    script = tmp_path / "train.py"
+    out = tmp_path / "env.txt"
+    script.write_text(textwrap.dedent(f"""
+        import os
+        with open({str(out)!r}, "a") as f:
+            f.write(os.environ["RANK"] + " " + os.environ["WORLD_SIZE"] +
+                    " " + os.environ["MASTER_ADDR"] + "\\n")
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.runner",
+         "--num_gpus", "2", "--master_port", "29511", str(script)],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr
+    lines = sorted(out.read_text().strip().splitlines())
+    assert lines == ["0 2 127.0.0.1", "1 2 127.0.0.1"]
+
+
+def test_failing_rank_propagates_exit_code(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("import sys; sys.exit(7)")
+    proc = subprocess.run(
+        [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.runner",
+         "--master_port", "29512", str(script)],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert proc.returncode == 7
+
+
+def test_elastic_launch_restarts(tmp_path):
+    marker = tmp_path / "attempts"
+    script = tmp_path / "flaky.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        p = {str(marker)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        sys.exit(0 if n >= 1 else 1)
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.runner",
+         "--elastic_training", "--max_elastic_restarts", "2",
+         "--master_port", "29513", str(script)],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr
+    assert int(marker.read_text()) == 2

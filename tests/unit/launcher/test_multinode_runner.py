@@ -1,0 +1,80 @@
+"""Generated multi-node command lines — analog of reference
+``tests/unit/launcher/test_multinode_runner.py``."""
+
+import pytest
+
+from deepspeed_tpu.launcher import multinode_runner as mnrunner
+from deepspeed_tpu.launcher.runner import encode_world_info, parse_args
+
+
+@pytest.fixture
+def runner_args():
+    return parse_args(["--master_addr", "10.0.0.1", "test_script.py",
+                       "--arg1", "val1"])
+
+
+@pytest.fixture
+def world_info():
+    return encode_world_info({"w1": [0, 1], "w2": [0, 1]})
+
+
+def test_pdsh_runner(runner_args, world_info):
+    runner = mnrunner.PDSHRunner(runner_args, world_info)
+    cmd = runner.get_cmd({}, {"w1": [0, 1], "w2": [0, 1]})
+    assert cmd[0] == "pdsh"
+    assert "-w" in cmd
+    assert "w1,w2" in cmd
+    joined = " ".join(cmd)
+    assert "deepspeed_tpu.launcher.launch" in joined
+    assert "--node_rank=%n" in joined
+    assert "--master_addr=10.0.0.1" in joined
+    assert "test_script.py" in joined
+
+
+def test_openmpi_runner(runner_args, world_info):
+    runner = mnrunner.OpenMPIRunner(runner_args, world_info,
+                                    {"w1": [0, 1], "w2": [0, 1]})
+    cmd = runner.get_cmd({}, {"w1": [0, 1], "w2": [0, 1]})
+    assert cmd[0] == "mpirun"
+    assert "-n" in cmd
+    assert "4" in cmd
+    assert "test_script.py" in cmd
+
+
+def test_mpich_runner(runner_args, world_info):
+    runner = mnrunner.MPICHRunner(runner_args, world_info, {"w1": 2, "w2": 2})
+    cmd = runner.get_cmd({}, {})
+    assert cmd[0] == "mpirun"
+    assert "-ppn" in cmd
+    assert "test_script.py" in cmd
+
+
+def test_mpich_runner_mismatched_slots(runner_args, world_info):
+    runner = mnrunner.MPICHRunner(runner_args, world_info, {"w1": 2, "w2": 1})
+    with pytest.raises(ValueError):
+        runner.get_cmd({}, {})
+
+
+def test_impi_runner(runner_args, world_info):
+    runner = mnrunner.IMPIRunner(runner_args, world_info, {"w1": 2, "w2": 2})
+    cmd = runner.get_cmd({}, {})
+    assert cmd[0] == "mpirun"
+    joined = " ".join(cmd)
+    assert "MASTER_ADDR" in joined
+    assert "10.0.0.1" in joined
+    assert "WORLD_SIZE" in joined
+
+
+def test_slurm_runner(runner_args, world_info):
+    runner = mnrunner.SlurmRunner(runner_args, world_info,
+                                  {"w1": [0, 1], "w2": [0, 1]})
+    cmd = runner.get_cmd({}, {})
+    assert cmd[0] == "srun"
+    assert "test_script.py" in cmd
+
+
+def test_exports_propagate(runner_args, world_info):
+    runner = mnrunner.PDSHRunner(runner_args, world_info)
+    runner.add_export("XLA_FLAGS", "--xla_foo=1")
+    cmd = runner.get_cmd({}, {"w1": [0]})
+    assert "XLA_FLAGS" in " ".join(cmd)

@@ -1,0 +1,77 @@
+"""Hostfile parsing + resource filtering — analog of reference
+``tests/unit/launcher/test_run.py``."""
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (
+    encode_world_info,
+    fetch_hostfile,
+    parse_inclusion_exclusion,
+)
+
+
+def write_hostfile(tmp_path, content):
+    p = tmp_path / "hostfile"
+    p.write_text(content)
+    return str(p)
+
+
+def test_parse_hostfile(tmp_path):
+    path = write_hostfile(tmp_path, "worker-1 slots=4\nworker-2 slots=4\n")
+    pool = fetch_hostfile(path)
+    assert pool == {"worker-1": 4, "worker-2": 4}
+
+
+def test_parse_hostfile_comments_and_blanks(tmp_path):
+    path = write_hostfile(
+        tmp_path, "# a comment\n\nworker-1 slots=2\n  \nworker-2 slots=8\n")
+    pool = fetch_hostfile(path)
+    assert pool == {"worker-1": 2, "worker-2": 8}
+
+
+def test_parse_hostfile_bad_line(tmp_path):
+    path = write_hostfile(tmp_path, "worker-1 slots=4\nbadline\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def test_parse_hostfile_duplicate(tmp_path):
+    path = write_hostfile(tmp_path, "w1 slots=4\nw1 slots=2\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def test_missing_hostfile_returns_none(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_include_filter():
+    pool = {"w1": 4, "w2": 4, "w3": 4}
+    active = parse_inclusion_exclusion(pool, "w1@w2:0,2", "")
+    assert active == {"w1": [0, 1, 2, 3], "w2": [0, 2]}
+
+
+def test_exclude_filter():
+    pool = {"w1": 4, "w2": 4}
+    active = parse_inclusion_exclusion(pool, "", "w1")
+    assert active == {"w2": [0, 1, 2, 3]}
+
+
+def test_exclude_slots():
+    pool = {"w1": 4}
+    active = parse_inclusion_exclusion(pool, "", "w1:1,3")
+    assert active == {"w1": [0, 2]}
+
+
+def test_include_unknown_host_raises():
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion({"w1": 4}, "nope", "")
+
+
+def test_world_info_roundtrip():
+    import base64
+    import json
+
+    info = {"w1": [0, 1], "w2": [0]}
+    b64 = encode_world_info(info)
+    assert json.loads(base64.urlsafe_b64decode(b64)) == info
