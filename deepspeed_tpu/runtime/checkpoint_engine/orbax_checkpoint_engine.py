@@ -1,0 +1,74 @@
+"""Orbax-backed checkpoint engine — sharded, multi-host, optionally async.
+
+Capability parity with reference ``NebulaCheckpointEngine``
+(runtime/checkpoint_engine/nebula_checkpoint_engine.py:20 — async tiered
+persistence) and the multi-host half of engine.save_checkpoint
+(engine.py:2858 per-rank shard files). TPU-native: orbax writes each
+process's addressable shards of a ``jax.Array`` pytree in parallel
+(the per-``zero_pp_rank`` file set of the reference, done by the library),
+and ``AsyncCheckpointer`` overlaps persistence with training exactly like
+Nebula's background commit.
+
+Non-array leaves (counters, scale state, python scalars) must be split off
+by the caller — the engine persists an array pytree + a JSON-able meta dict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+from ...utils.logging import log_dist
+from .checkpoint_engine import CheckpointEngine
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    def __init__(self, config_params=None, use_async: bool = True):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.use_async = use_async
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler()) \
+            if use_async else ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+
+    def save(self, state_dict: Any, path: str) -> None:
+        """``state_dict`` = {"arrays": <jax pytree (may be sharded)>,
+        "meta": <json-able dict>}."""
+        arrays = state_dict["arrays"]
+        meta = state_dict.get("meta", {})
+        path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._ckptr.save(path, arrays, force=True)
+        import jax
+
+        if jax.process_index() == 0:
+            with open(path + ".meta.json", "w") as f:
+                json.dump(meta, f, default=str)
+
+    def load(self, path: str, map_location=None,
+             restore_target: Any = None) -> Any:
+        """``restore_target``: pytree of jax.ShapeDtypeStruct with shardings
+        (or concrete arrays) directing where shards land — this is how a
+        universal-style re-shard on load happens with orbax."""
+        path = os.path.abspath(path)
+        kwargs = {}
+        if restore_target is not None:
+            kwargs["restore_args"] = \
+                self._ocp.checkpoint_utils.construct_restore_args(restore_target)
+        arrays = self._ckptr.restore(path, **kwargs)
+        meta = {}
+        meta_path = path + ".meta.json"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+        return {"arrays": arrays, "meta": meta}
+
+    def commit(self, tag: str) -> bool:
+        """Block until async writes for the tag are durable (Nebula's
+        commit barrier)."""
+        if self.use_async:
+            self._ckptr.wait_until_finished()
+        log_dist(f"[DSTPU] orbax checkpoint {tag} committed", ranks=[0])
+        return True

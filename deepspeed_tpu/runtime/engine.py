@@ -825,6 +825,76 @@ class DeepSpeedEngine:
         dist.barrier(name="save_checkpoint")
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
 
+    def load_universal_checkpoint(self, load_dir: str,
+                                  tag: Optional[str] = None):
+        """Load a universal checkpoint at the CURRENT parallelism layout —
+        reference engine.py:782 ``load_universal_checkpoint`` +
+        checkpoint/universal_checkpoint.py:12. Arrays are whole logical
+        tensors; ``device_put`` against this engine's shardings performs the
+        re-shard (any dp/tp/pp/sp resize)."""
+        import flax.serialization as fser
+
+        from ..checkpoint.universal_checkpoint import (
+            load_universal,
+            universal_dir,
+        )
+
+        if tag is None:
+            tag = read_latest(load_dir)
+        univ = load_universal(universal_dir(load_dir, tag))
+        assert self.state is not None, \
+            "engine state not built yet — init params before universal load"
+        host = jax.device_get(self.state)
+        new_state = dict(self.state)
+
+        fp32 = univ["fp32"]
+        if host["master"] is not None:
+            restored_master = fser.from_state_dict(host["master"], fp32)
+            new_state["master"] = jax.device_put(
+                restored_master, self._shardings["master"])
+            restored = restored_master
+        else:
+            restored = fser.from_state_dict(host["params"], fp32)
+        # always recast to each param's compute dtype — the universal file
+        # is fp32 regardless of how this engine computes
+        new_params = jax.tree_util.tree_map(
+            lambda m, p: jnp.asarray(m).astype(jnp.asarray(p).dtype),
+            restored, host["params"])
+        new_state["params"] = jax.device_put(new_params,
+                                             self._shardings["params"])
+
+        opt = univ["opt"]
+        if self._offload_opt is not None:
+            # host-resident master + moments: restore them into the offload
+            # manager (fp32 master from the universal file; m/v if present)
+            self._offload_opt.load_universal(restored, opt)
+        if opt and host["opt_state"] is not None:
+            opt_sd = fser.to_state_dict(host["opt_state"])
+            merged = dict(opt_sd)
+            for name, tree in opt.items():
+                if name in merged:
+                    merged[name] = tree
+            new_state["opt_state"] = jax.device_put(
+                fser.from_state_dict(host["opt_state"], merged),
+                self._shardings["opt_state"])
+
+        meta = univ["meta"]
+        new_state["step"] = jnp.asarray(meta.get("step", 0), jnp.int32)
+        new_state["opt_step"] = jnp.asarray(
+            meta.get("opt_step", meta.get("step", 0)), jnp.int32)
+        self.global_steps = meta.get("global_steps", 0)
+        self.global_samples = meta.get("global_samples", 0)
+        self.micro_steps = meta.get("micro_steps", 0)
+        self.skipped_steps = meta.get("skipped_steps", 0)
+        if self.lr_scheduler is not None and meta.get("lr_scheduler") and \
+                hasattr(self.lr_scheduler, "load_state_dict"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        self.state = new_state
+        log_dist(f"loaded universal checkpoint {load_dir}/{tag} "
+                 f"(saved at dp={meta.get('source_dp_world_size')}, "
+                 f"now dp={self.dp_world_size})", ranks=[0])
+        return load_dir, {}
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_module_strict: bool = True,
                         load_optimizer_states: bool = True,
@@ -832,6 +902,8 @@ class DeepSpeedEngine:
                         load_module_only: bool = False):
         import flax.serialization as fser
 
+        if self._config.checkpoint.load_universal:
+            return self.load_universal_checkpoint(load_dir, tag)
         if tag is None:
             tag = read_latest(load_dir)
         path = checkpoint_meta_path(load_dir, tag, "model", mp_rank=0, dp_rank=0)

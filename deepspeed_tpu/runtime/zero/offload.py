@@ -193,6 +193,27 @@ class OffloadedOptimizer:
             self._swap_out_all()
         return sd
 
+    def load_universal(self, master_tree, opt_trees: Dict) -> None:
+        """Restore from a universal checkpoint: fp32 master from the nested
+        param tree, Adam moments from ``opt_trees['exp_avg'/'exp_avg_sq']``
+        (nested, param-shaped) when present — keeps momentum across elastic
+        resumes instead of silently re-zeroing it."""
+        self.sync_master_from(master_tree)
+        name_to_attr = {"exp_avg": self.m, "exp_avg_sq": self.v}
+        if self.nvme:
+            self._swap_in_all()
+        for name, store in name_to_attr.items():
+            tree = opt_trees.get(name)
+            if tree is None:
+                continue
+            flat = _flatten_with_paths(tree)
+            for p, leaf in flat.items():
+                if p in store and self._float.get(p):
+                    store[p] = np.ascontiguousarray(
+                        np.asarray(leaf, np.float32)).ravel()
+        if self.nvme:
+            self._swap_out_all()
+
     def load_state_dict(self, sd: Dict) -> None:
         if self.nvme:
             self._swap_in_all()

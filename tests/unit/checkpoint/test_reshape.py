@@ -1,0 +1,145 @@
+"""Reshape maps + reference-checkpoint migration — analog of reference
+``tests/unit/checkpoint/test_reshape_checkpoint.py``."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint import (
+    DeepSpeedCheckpoint,
+    get_model_3d_descriptor,
+    model_3d_desc,
+    reshape_meg_2d_parallel,
+)
+
+
+def test_reshape_222_to_111():
+    m = reshape_meg_2d_parallel(2, 2, 1, 1)
+    assert m.get_data(0, 0) == [0, 1, 2, 3]
+
+
+def test_reshape_tp_shrink():
+    m = reshape_meg_2d_parallel(1, 4, 1, 2)
+    assert m.get_data(0, 0) == [0, 1]
+    assert m.get_data(0, 1) == [2, 3]
+
+
+def test_reshape_pp_shrink():
+    m = reshape_meg_2d_parallel(4, 1, 2, 1)
+    assert m.get_data(0, 0) == [0, 1]
+    assert m.get_data(1, 0) == [2, 3]
+
+
+def test_reshape_expansion_rejected():
+    with pytest.raises(AssertionError):
+        reshape_meg_2d_parallel(1, 2, 1, 4)
+
+
+def test_3d_desc_reshape():
+    src = model_3d_desc(pp_degree=2, tp_degree=2, dp_degree=2)
+    tgt = model_3d_desc(pp_degree=1, tp_degree=1, dp_degree=1)
+    ok, errs = src.can_reshape(tgt)
+    assert ok, errs
+    dp_maps = src.reshape(tgt)
+    assert len(dp_maps) == 1
+    # all 8 source ranks land on the single target coordinate
+    assert sorted(dp_maps[0].get_data(0, 0)) == list(range(8))
+
+
+def test_3d_desc_rejects_expansion():
+    src = model_3d_desc(1, 1, 1)
+    tgt = model_3d_desc(2, 1, 1)
+    ok, errs = src.can_reshape(tgt)
+    assert not ok and errs
+
+
+def _make_reference_ckpt(tmp_path, tp=2, n_layers=2, hidden=8):
+    """Fake Megatron-DeepSpeed layer-file checkpoint: layer_00 embedding,
+    layer_01..n transformer, last = final norm; one file per tp rank."""
+    torch = pytest.importorskip("torch")
+    d = tmp_path / "ref_ckpt"
+    d.mkdir()
+    layer_ids = [0] + list(range(1, n_layers + 1)) + [n_layers + 1]
+    for lid in layer_ids:
+        for tp_rank in range(tp):
+            if lid == 0:
+                sd = {"word_embeddings.weight":
+                      torch.randn(16 // tp, hidden)}
+            elif lid == layer_ids[-1]:
+                sd = {"weight": torch.ones(hidden), "bias": torch.zeros(hidden)}
+            else:
+                sd = {
+                    "input_layernorm.weight": torch.ones(hidden),
+                    "self_attention.query_key_value.weight":
+                        torch.randn(3 * hidden // tp, hidden),
+                    "self_attention.dense.weight":
+                        torch.randn(hidden, hidden // tp),
+                    "mlp.dense_h_to_4h.weight":
+                        torch.randn(4 * hidden // tp, hidden),
+                    "mlp.dense_4h_to_h.weight":
+                        torch.randn(hidden, 4 * hidden // tp),
+                }
+            torch.save(sd, d / f"layer_{lid:02d}-model_{tp_rank:02d}"
+                       f"-model_states.pt")
+    for tp_rank in range(tp):
+        torch.save({"iteration": 42},
+                   d / f"mp_rank_{tp_rank:02d}_model_states.pt")
+    return d
+
+
+def test_3d_descriptor_from_reference_dir(tmp_path):
+    d = _make_reference_ckpt(tmp_path, tp=2, n_layers=2)
+    desc = get_model_3d_descriptor(str(d))
+    assert desc.tp_degree == 2
+    assert desc.pp_degree == 1
+
+
+def test_deepspeed_checkpoint_reader(tmp_path):
+    d = _make_reference_ckpt(tmp_path, tp=2, n_layers=2, hidden=8)
+    ckpt = DeepSpeedCheckpoint(str(d))
+    assert ckpt.original_tp_degree == 2
+    assert ckpt.get_iteration() == 42
+    # at the original tp, each tp index sees its own shard
+    emb = ckpt.get_embedding_state(0)
+    assert emb["word_embeddings.weight"].shape == (8, 8)
+    t_states = ckpt.get_transformer_state(0, 0)
+    assert t_states, "expected transformer layer states"
+
+    # shrinking to tp=1 merges the shards
+    ckpt1 = DeepSpeedCheckpoint(str(d), tp_degree=1)
+    emb1 = ckpt1.get_embedding_state(0)
+    assert emb1["word_embeddings.weight"].shape == (16, 8)
+    norm = ckpt1.get_final_norm_state(0)
+    assert norm["weight"].shape == (8,)
+
+
+def test_migration_to_universal(tmp_path):
+    d = _make_reference_ckpt(tmp_path, tp=2, n_layers=2, hidden=8)
+    ckpt = DeepSpeedCheckpoint(str(d))
+    out = ckpt.to_universal(str(tmp_path), tag="mig")
+    from deepspeed_tpu.checkpoint import load_universal
+
+    blob = load_universal(out)
+    flat_keys = []
+
+    def walk(t, p=""):
+        for k, v in t.items():
+            if isinstance(v, dict):
+                walk(v, p + k + "/")
+            else:
+                flat_keys.append(p + k)
+
+    walk(blob["fp32"])
+    # qkv merged over tp: 3*8 = 24 rows
+    qkv = [k for k in flat_keys if "query_key_value" in k]
+    assert qkv
+
+    def get(t, path):
+        for p in path.split("/"):
+            t = t[p]
+        return t
+
+    assert get(blob["fp32"], qkv[0]).shape == (24, 8)
+    # row-parallel dense merged on dim 1
+    dense = [k for k in flat_keys if "dense/weight" in k and "attention" in k]
+    assert get(blob["fp32"], dense[0]).shape == (8, 8)
+    assert blob["meta"]["step"] == 42
