@@ -1,0 +1,3 @@
+from .dropping_utils import gather_tokens, sample_tokens, scatter_tokens
+
+__all__ = ["sample_tokens", "gather_tokens", "scatter_tokens"]

@@ -1,0 +1,48 @@
+"""Random-LTD token selection / gather / scatter.
+
+TPU-native equivalent of the reference random-LTD kernels
+(``csrc/random_ltd/{token_sort.cu,gather_scatter.cu}``, bound in
+``ops/random_ltd/dropping_utils.py:82,106``): select a random *sorted*
+subset of token positions per sequence, gather them for the wrapped layer,
+and scatter the layer's outputs back over the originals. On TPU these are
+pure ``jnp`` gathers (XLA lowers them to efficient dynamic-slices); sorting
+keeps relative token order, matching the reference's token_sort kernel.
+
+All shapes are static under jit: ``reserved_length`` must be a Python int
+at trace time (the scheduler buckets it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(rng: jax.Array, batch: int, seq_length: int,
+                  reserved_length: int) -> jnp.ndarray:
+    """Per-sequence sorted random selection of ``reserved_length`` positions
+    out of ``seq_length`` — reference gpt_sample_tokens/bert_sample_tokens.
+    Returns int32 indices of shape (batch, reserved_length)."""
+    if reserved_length >= seq_length:
+        return jnp.broadcast_to(jnp.arange(seq_length, dtype=jnp.int32),
+                                (batch, seq_length))
+    noise = jax.random.uniform(rng, (batch, seq_length))
+    # indices of the reserved_length smallest noise values, then sort to
+    # preserve token order (token_sort.cu)
+    _, idx = jax.lax.top_k(-noise, reserved_length)
+    return jnp.sort(idx, axis=-1).astype(jnp.int32)
+
+
+def gather_tokens(x: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """Gather (batch, seq, hidden) → (batch, reserved, hidden) —
+    reference gather_scatter.cu forward."""
+    return jnp.take_along_axis(x, indices[..., None], axis=1)
+
+
+def scatter_tokens(base: jnp.ndarray, updated: jnp.ndarray,
+                   indices: jnp.ndarray) -> jnp.ndarray:
+    """Scatter (batch, reserved, hidden) back into (batch, seq, hidden);
+    unselected positions keep ``base`` — reference gather_scatter.cu
+    backward path / vanilla-scatter."""
+    batch_idx = jnp.arange(base.shape[0])[:, None]
+    return base.at[batch_idx, indices].set(updated)

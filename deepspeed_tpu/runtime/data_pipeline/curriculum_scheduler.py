@@ -1,0 +1,132 @@
+"""Curriculum-learning difficulty scheduler.
+
+Capability parity with reference
+``deepspeed/runtime/data_pipeline/curriculum_scheduler.py:11
+CurriculumScheduler`` — maps global step → difficulty (e.g. sequence
+length) under ``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` /
+``custom`` schedules. Pure arithmetic; on TPU the consumer additionally
+**buckets** the difficulty (see ``difficulty_step``) so the set of distinct
+sequence lengths — and hence XLA recompiles — stays small.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+from ...utils.logging import logger
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            assert key in config, \
+                f"Curriculum learning requires the config '{key}'"
+        self.state: Dict[str, Any] = {
+            "min_difficulty": config["min_difficulty"],
+            "max_difficulty": config["max_difficulty"],
+            "current_difficulty": config["min_difficulty"],
+            "schedule_type": config["schedule_type"],
+        }
+        self.first_step = True
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        schedule_type = config["schedule_type"]
+        schedule_config = config.get("schedule_config", {})
+
+        if schedule_type == FIXED_DISCRETE:
+            # difficulty: [d0, d1, ...], max_step: [s0, s1, ...] with one
+            # fewer steps than difficulties (last difficulty holds forever)
+            assert "difficulty" in schedule_config
+            assert "max_step" in schedule_config
+            assert len(schedule_config["difficulty"]) == \
+                len(schedule_config["max_step"]) + 1
+        elif schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            assert "total_curriculum_step" in schedule_config, \
+                f"'{schedule_type}' schedule requires total_curriculum_step"
+            assert "difficulty_step" in schedule_config, \
+                f"'{schedule_type}' schedule requires difficulty_step"
+            if schedule_type == FIXED_ROOT:
+                assert "root_degree" in schedule_config, \
+                    "'fixed_root' schedule requires root_degree"
+            if schedule_config["difficulty_step"] % 8 != 0:
+                logger.warning(
+                    "difficulty_step should be a multiple of 8 so seqlen "
+                    "buckets stay MXU-tile friendly (and recompiles stay "
+                    "few) — disregard if the metric is not seqlen")
+        elif schedule_type == CUSTOM:
+            pass  # set_custom_get_difficulty must be called
+        else:
+            raise RuntimeError(f"Unsupported schedule type {schedule_type}")
+        self.state["schedule_config"] = schedule_config
+
+    # -- reference API ----------------------------------------------------
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.state["current_difficulty"] = difficulty
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def get_state(self) -> Dict[str, Any]:
+        return self.state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.state = state
+
+    # -- schedule math ----------------------------------------------------
+    def __fixed_discrete_get_difficulty(self, global_steps: int) -> int:
+        sc = self.state["schedule_config"]
+        for i, max_step in enumerate(sc["max_step"]):
+            if global_steps <= max_step:
+                return sc["difficulty"][i]
+        return sc["difficulty"][-1]
+
+    def __fixed_root_get_difficulty(self, global_steps: int,
+                                    root_degree: Optional[int] = None) -> int:
+        sc = self.state["schedule_config"]
+        if root_degree is None:
+            root_degree = sc["root_degree"]
+        next_difficulty = (float(global_steps) /
+                           sc["total_curriculum_step"]) ** (1.0 / root_degree)
+        next_difficulty = math.floor(
+            next_difficulty *
+            (self.state["max_difficulty"] - self.state["min_difficulty"]) +
+            self.state["min_difficulty"])
+        # bucket to a multiple of difficulty_step (bounds recompiles on TPU)
+        next_difficulty -= next_difficulty % sc["difficulty_step"]
+        return min(next_difficulty, self.state["max_difficulty"])
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == FIXED_DISCRETE:
+            return self.__fixed_discrete_get_difficulty(global_steps)
+        if stype == FIXED_LINEAR:
+            return self.__fixed_root_get_difficulty(global_steps, 1)
+        if stype == FIXED_ROOT:
+            return self.__fixed_root_get_difficulty(global_steps)
+        if stype == CUSTOM:
+            assert self.custom_get_difficulty is not None, \
+                "custom schedule requires set_custom_get_difficulty()"
+            return self.custom_get_difficulty(global_steps)
+        raise RuntimeError(f"Unsupported schedule type {stype}")
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.state["current_difficulty"] < self.state["max_difficulty"]:
+            self.state["current_difficulty"] = max(
+                self.get_difficulty(global_steps),
+                self.state["min_difficulty"])
+        return self.state["current_difficulty"]
+
+    # -- checkpoint -------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return dict(self.state)
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.state = dict(sd)

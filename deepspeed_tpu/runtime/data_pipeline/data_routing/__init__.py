@@ -1,0 +1,4 @@
+from .basic_layer import RandomLayerTokenDrop
+from .scheduler import RandomLTDScheduler
+
+__all__ = ["RandomLayerTokenDrop", "RandomLTDScheduler"]

@@ -1,0 +1,67 @@
+"""Random layerwise token dropping (random-LTD).
+
+Capability parity with reference
+``deepspeed/runtime/data_pipeline/data_routing/basic_layer.py:14
+RandomLayerTokenDrop`` — wraps a transformer layer so that during training
+only a random subset of tokens flows through it; the rest bypass via the
+residual. The reference mutates the wrapped torch module; the flax version
+is a combinator module, and the reserved length arrives as a *static*
+argument (bucketed by :class:`RandomLTDScheduler`) so each bucket compiles
+once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ....ops.random_ltd import gather_tokens, sample_tokens, scatter_tokens
+
+
+class RandomLayerTokenDrop(nn.Module):
+    """Wraps ``layer`` (a flax Module taking (hidden, *args, **kwargs) and
+    returning hidden of the same shape) with token dropping."""
+
+    layer: nn.Module
+    rng_collection: str = "random_ltd"
+
+    @nn.compact
+    def __call__(self, hidden_states: jnp.ndarray, *args,
+                 reserved_length: Optional[int] = None,
+                 attention_mask: Optional[jnp.ndarray] = None,
+                 deterministic: bool = False, **kwargs):
+        seq_length = hidden_states.shape[1]
+        if deterministic or reserved_length is None or \
+                reserved_length >= seq_length:
+            if attention_mask is not None:
+                kwargs["attention_mask"] = attention_mask
+            return self.layer(hidden_states, *args, **kwargs)
+
+        rng = self.make_rng(self.rng_collection)
+        idx = sample_tokens(rng, hidden_states.shape[0], seq_length,
+                            reserved_length)
+        part = gather_tokens(hidden_states, idx)
+        if attention_mask is not None:
+            # slice the mask to the selected tokens (reference
+            # bert/gpt_sample_tokens return the partitioned mask alongside):
+            # (b, s) keys → gather dim 1; (b, s, s) / (b, h, s, s) pairwise
+            # masks → gather the last two dims
+            if attention_mask.ndim == 2:
+                kwargs["attention_mask"] = jnp.take_along_axis(
+                    attention_mask, idx, axis=1)
+            else:
+                b, r = idx.shape
+                mid = (1,) * (attention_mask.ndim - 3)
+                rows = idx.reshape(b, *mid, r, 1)
+                cols = idx.reshape(b, *mid, 1, r)
+                m = jnp.take_along_axis(attention_mask, rows, axis=-2)
+                kwargs["attention_mask"] = jnp.take_along_axis(m, cols,
+                                                               axis=-1)
+        out = self.layer(part, *args, **kwargs)
+        if isinstance(out, tuple):
+            out, *rest = out
+            return (scatter_tokens(hidden_states, out, idx), *rest)
+        return scatter_tokens(hidden_states, out, idx)

@@ -1,0 +1,52 @@
+"""Random-LTD schedule.
+
+Capability parity with reference
+``deepspeed/runtime/data_pipeline/data_routing/scheduler.py`` — ramps the
+number of *kept* tokens from ``min_value`` to ``max_value`` over
+``total_layer_token_budget`` steps. Values are bucketed to
+``value_step_size`` so the set of distinct reserved lengths (and hence XLA
+compiles) stays bounded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class RandomLTDScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        sched = config.get("random_ltd_schedule", config)
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 1024))
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        sc = sched.get("schedule_config", {})
+        self.total_steps = int(sc.get("require_steps",
+                                      sc.get("total_curriculum_step", 10000)))
+        self.step_size = int(sc.get("seq_per_step", 8))
+        self.current_value = self.min_value
+        self.global_steps = 0
+
+    def get_current_seq(self) -> int:
+        return self.current_value
+
+    def update_seq(self, global_steps: int) -> int:
+        self.global_steps = global_steps
+        if self.schedule_type == "fixed_linear":
+            value = self.min_value + \
+                (self.max_value - self.min_value) * \
+                min(1.0, global_steps / max(self.total_steps, 1))
+        else:
+            raise RuntimeError(
+                f"Unsupported random-ltd schedule {self.schedule_type}")
+        value = int(value) - int(value) % self.step_size
+        self.current_value = max(self.min_value,
+                                 min(value, self.max_value))
+        return self.current_value
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_value": self.current_value,
+                "global_steps": self.global_steps}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.current_value = sd["current_value"]
+        self.global_steps = sd["global_steps"]

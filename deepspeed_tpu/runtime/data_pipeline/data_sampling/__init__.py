@@ -1,0 +1,5 @@
+from .data_sampler import DeepSpeedDataSampler
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+
+__all__ = ["DeepSpeedDataSampler", "MMapIndexedDataset",
+           "MMapIndexedDatasetBuilder"]

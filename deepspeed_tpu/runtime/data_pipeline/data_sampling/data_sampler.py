@@ -1,0 +1,148 @@
+"""Curriculum-learning data sampler.
+
+Capability parity with reference
+``deepspeed/runtime/data_pipeline/data_sampling/data_sampler.py:36
+DeepSpeedDataSampler`` — samples global batches restricted to the current
+curriculum difficulty, using per-sample metric values (e.g. seqlen,
+vocab rarity) indexed offline by the data analyzer. Samples are grouped
+into difficulty *clusters*; each batch draws from the union of unlocked
+clusters, and previously-seen clusters are reshuffled when exhausted.
+
+Metric modes (reference constants):
+  * ``value`` — difficulty thresholds compare raw metric values
+  * ``percentile`` — thresholds are percentiles of the metric distribution
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ....utils.logging import logger
+from ..curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, data_efficiency_config: Dict[str, Any],
+                 one_epoch_total_samples: int,
+                 micro_batch_size: int,
+                 data_parallel_rank: int,
+                 data_parallel_size: int,
+                 gradient_accumulation_steps: int = 1,
+                 global_rank: int = 0,
+                 drop_last: bool = True,
+                 metric_values: Optional[Sequence[float]] = None,
+                 seed: int = 1234):
+        self.config = data_efficiency_config
+        self.total_samples = one_epoch_total_samples
+        self.micro_batch_size = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.gas = gradient_accumulation_steps
+        self.global_batch_size = micro_batch_size * data_parallel_size * \
+            gradient_accumulation_steps
+        self.drop_last = drop_last
+        self.rng = np.random.default_rng(seed)
+        self.consumed_samples = 0
+
+        cl_cfg = data_efficiency_config.get("curriculum_learning", {})
+        self.curriculum_enabled = bool(cl_cfg.get("enabled", False))
+        self.curriculum_schedulers: Dict[str, CurriculumScheduler] = {}
+        self.difficulty_type: Dict[str, str] = {}
+        self.metric_values: Dict[str, np.ndarray] = {}
+        self.current_difficulties: Dict[str, int] = {}
+        if self.curriculum_enabled:
+            metrics = cl_cfg.get("curriculum_metrics", {})
+            for name, mcfg in metrics.items():
+                self.curriculum_schedulers[name] = CurriculumScheduler(mcfg)
+                self.difficulty_type[name] = mcfg.get("difficulty_type",
+                                                      "value")
+                if metric_values is not None and not isinstance(
+                        metric_values, dict):
+                    self.metric_values[name] = np.asarray(metric_values)
+            if isinstance(metric_values, dict):
+                for name, vals in metric_values.items():
+                    self.metric_values[name] = np.asarray(vals)
+            for name in self.curriculum_schedulers:
+                assert name in self.metric_values, \
+                    f"metric values for '{name}' are required (the offline " \
+                    f"data analyzer produces them)"
+        self.np_rng = self.rng
+
+    def __len__(self) -> int:
+        return self.total_samples
+
+    def set_custom_curriculum_learning_schedule(self, schedule_func_dict):
+        for name, fn in schedule_func_dict.items():
+            if name in self.curriculum_schedulers:
+                self.curriculum_schedulers[name].set_custom_get_difficulty(fn)
+
+    # -- difficulty-constrained index pool --------------------------------
+    def _eligible_indices(self) -> np.ndarray:
+        if not self.curriculum_enabled:
+            return np.arange(self.total_samples)
+        mask = np.ones(self.total_samples, dtype=bool)
+        for name, sched in self.curriculum_schedulers.items():
+            difficulty = self.current_difficulties[name]
+            values = self.metric_values[name][:self.total_samples]
+            if self.difficulty_type[name] == "percentile":
+                threshold = np.percentile(values, difficulty)
+            else:
+                threshold = difficulty
+            mask &= values <= threshold
+        idx = np.nonzero(mask)[0]
+        if idx.size == 0:
+            # never return an empty pool: fall back to the easiest samples
+            # by the FIRST configured metric (schedulers dict preserves the
+            # config's metric order), restricted to this dataset's samples
+            first = next(iter(self.curriculum_schedulers))
+            values = self.metric_values[first][:self.total_samples]
+            idx = np.argsort(values)[:self.global_batch_size]
+        return idx
+
+    def get_next_global_batch(self) -> np.ndarray:
+        step = self.consumed_samples // self.global_batch_size
+        if self.curriculum_enabled:
+            for name, sched in self.curriculum_schedulers.items():
+                self.current_difficulties[name] = sched.update_difficulty(step)
+        pool = self._eligible_indices()
+        batch = self.np_rng.choice(pool, size=self.global_batch_size,
+                                   replace=pool.size < self.global_batch_size)
+        self.consumed_samples += self.global_batch_size
+        return batch
+
+    def __iter__(self) -> Iterator[List[int]]:
+        """One epoch of batches (standard batch-sampler contract):
+        ``drop_last=True`` floors to whole global batches; ``False`` adds a
+        final wrapped batch covering the remainder. Restart iteration for
+        the next epoch — curriculum difficulty carries across epochs via
+        ``consumed_samples``."""
+        full_batches = self.total_samples // self.global_batch_size
+        remainder = self.total_samples % self.global_batch_size
+        n_batches = full_batches + (1 if remainder and not self.drop_last
+                                    else 0)
+        for _ in range(n_batches):
+            batch = self.get_next_global_batch()
+            # this dp rank's contiguous slice (reference get_start_end_idx)
+            start = self.dp_rank * self.micro_batch_size * self.gas
+            end = start + self.micro_batch_size * self.gas
+            yield batch[start:end].tolist()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "consumed_samples": self.consumed_samples,
+            "curriculum_states": {
+                name: sched.state_dict()
+                for name, sched in self.curriculum_schedulers.items()
+            },
+            "rng": self.np_rng.bit_generator.state,
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.consumed_samples = sd["consumed_samples"]
+        for name, state in sd.get("curriculum_states", {}).items():
+            if name in self.curriculum_schedulers:
+                self.curriculum_schedulers[name].load_state_dict(state)
+        if "rng" in sd:
+            self.np_rng.bit_generator.state = sd["rng"]

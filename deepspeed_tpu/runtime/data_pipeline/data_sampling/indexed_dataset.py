@@ -1,0 +1,118 @@
+"""Memory-mapped indexed dataset.
+
+Capability parity with reference
+``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py`` (617
+LoC, the Megatron-LM mmap format) — a binary token file (``.bin``) plus an
+index (``.idx``) of per-document offsets/lengths, read zero-copy via numpy
+memmap. Used by the data analyzer / curriculum sampler to address samples
+by difficulty without loading the corpus.
+
+Format (own layout, same capability): ``.idx`` holds a header
+(magic, version, dtype code, count) followed by int64 offsets and int32
+lengths; ``.bin`` is the raw concatenated sample arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+    5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    def __init__(self, out_file_prefix: str, dtype=np.int32):
+        self._prefix = out_file_prefix
+        self._dtype = np.dtype(dtype)
+        self._data_file = open(data_file_path(out_file_prefix), "wb")
+        self._lengths: List[int] = []
+
+    def add_item(self, array: Sequence) -> None:
+        arr = np.asarray(array, dtype=self._dtype)
+        self._data_file.write(arr.tobytes(order="C"))
+        self._lengths.append(arr.size)
+
+    def merge_file_(self, another_prefix: str) -> None:
+        other = MMapIndexedDataset(another_prefix)
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self) -> None:
+        self._data_file.close()
+        lengths = np.asarray(self._lengths, dtype=np.int32)
+        offsets = np.zeros(len(lengths), dtype=np.int64)
+        if len(lengths) > 1:
+            np.cumsum(lengths[:-1] * self._dtype.itemsize, out=offsets[1:])
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<HHq", _VERSION,
+                                _DTYPE_CODES[self._dtype], len(lengths)))
+            f.write(offsets.tobytes(order="C"))
+            f.write(lengths.tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    def __init__(self, prefix: str, skip_warmup: bool = True):
+        self._prefix = prefix
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            assert magic == _MAGIC, f"bad index file magic in {prefix}.idx"
+            version, dtype_code, count = struct.unpack("<HHq", f.read(12))
+            assert version == _VERSION
+            self._dtype = np.dtype(_DTYPES[dtype_code])
+            self._count = count
+            header = f.tell()
+        self._offsets = np.memmap(index_file_path(prefix), dtype=np.int64,
+                                  mode="r", offset=header, shape=(count,))
+        self._lengths = np.memmap(index_file_path(prefix), dtype=np.int32,
+                                  mode="r", offset=header + 8 * count,
+                                  shape=(count,))
+        self._data = np.memmap(data_file_path(prefix), dtype=self._dtype,
+                               mode="r")
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        offset = int(self._offsets[idx]) // self._dtype.itemsize
+        length = int(self._lengths[idx])
+        return np.asarray(self._data[offset:offset + length])
+
+    def get(self, idx: int, offset: int = 0, length: int = None):
+        base = int(self._offsets[idx]) // self._dtype.itemsize + offset
+        if length is None:
+            length = int(self._lengths[idx]) - offset
+        return np.asarray(self._data[base:base + length])
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return np.asarray(self._lengths)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return os.path.exists(index_file_path(prefix)) and \
+            os.path.exists(data_file_path(prefix))

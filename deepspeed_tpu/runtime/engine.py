@@ -174,6 +174,24 @@ class DeepSpeedEngine:
                        verbose=cl.verbose, debug=cl.debug)
         self.checkpoint_engine = ArrayCheckpointEngine()
 
+        # curriculum learning (reference engine.py:1714-1718 seqlen
+        # truncation + curriculum_scheduler.py) — bucketed difficulty keeps
+        # the set of distinct shapes (and XLA compiles) small
+        self.curriculum_scheduler = None
+        cl = self._config.curriculum_learning
+        if cl.enabled:
+            from .data_pipeline.curriculum_scheduler import (
+                CurriculumScheduler,
+            )
+
+            self.curriculum_scheduler = CurriculumScheduler({
+                "min_difficulty": cl.min_difficulty,
+                "max_difficulty": cl.max_difficulty,
+                "schedule_type": cl.schedule_type,
+                "schedule_config": cl.schedule_config,
+            })
+            self._curriculum_type = cl.curriculum_type
+
         # activation checkpointing from the JSON block (reference
         # engine._configure_checkpointing → checkpointing.configure,
         # checkpointing.py:789)
@@ -615,6 +633,7 @@ class DeepSpeedEngine:
             "pass exactly one of data_iter / batch"
         source = data_iter if data_iter is not None else batch
         stacked = self._stack_micro_batches(source)
+        stacked = self._apply_curriculum(stacked)
         if self.state is None:
             first = jax.tree_util.tree_map(lambda x: x[0], stacked)
             self._build_state(self._init_params_from_batch(first))
@@ -636,6 +655,31 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).stop()
         self._after_step(metrics)
         return loss
+
+    def _apply_curriculum(self, stacked):
+        """Truncate the sequence dim to the current curriculum difficulty
+        (seqlen metric) — reference engine.py:1714-1718."""
+        if self.curriculum_scheduler is None or \
+                self._curriculum_type != "seqlen":
+            return stacked
+        seqlen = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+        # the full sequence length = the largest trailing-dim size among
+        # (gas, batch, seq, ...) leaves; truncate EVERY axis of that size so
+        # attention masks (gas, b, seq, seq) stay consistent with input_ids
+        full = max((x.shape[2] for x in jax.tree_util.tree_leaves(stacked)
+                    if np.ndim(x) >= 3), default=0)
+        if full <= seqlen:
+            return stacked
+
+        def truncate(x):
+            if np.ndim(x) < 3:
+                return x
+            idx = tuple(slice(0, seqlen) if i >= 2 and x.shape[i] == full
+                        else slice(None) for i in range(np.ndim(x)))
+            return x[idx]
+
+        return jax.tree_util.tree_map(truncate, stacked)
 
     def _maybe_profile_flops(self, stacked_batch) -> None:
         """Engine-integrated flops profiler at ``profile_step`` — reference

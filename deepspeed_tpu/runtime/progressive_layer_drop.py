@@ -1,0 +1,61 @@
+"""Progressive Layer Dropping (PLD).
+
+Capability parity with reference ``deepspeed/runtime/progressive_layer_drop.py``
+— the keep-probability schedule θ(t) = (1-θ̄)·exp(-γt) + θ̄ from the PLD
+paper, fed to the model each step (reference engine.py:1553,1709). The flax
+side consumes ``pld_theta`` as a per-layer keep probability: layer i of L
+keeps with probability 1 - (i/L)·(1-θ); :class:`LayerDrop` implements that
+stochastic skip with the residual as identity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        self.current_theta = \
+            (1.0 - self.theta) * float(np.exp(-self.gamma * global_step)) + \
+            self.theta
+
+
+class LayerDrop:
+    """Functional helper: stochastically skip ``layer_fn`` with the PLD
+    per-depth keep probability. Use inside a flax module:
+
+        keep_p = pld_keep_prob(theta, layer_id, num_layers)
+        x = maybe_drop_layer(rng, keep_p, x, lambda h: block(h), deterministic)
+    """
+
+
+def pld_keep_prob(theta: float, layer_id: int, num_layers: int) -> float:
+    """Deeper layers drop more often (PLD paper eq. 5)."""
+    return 1.0 - (float(layer_id + 1) / max(num_layers, 1)) * (1.0 - theta)
+
+
+def maybe_drop_layer(rng, keep_prob, x, layer_fn, deterministic: bool = False):
+    """Bernoulli layer skip with identity residual; at eval, always run and
+    scale is unnecessary because PLD trains with unscaled residuals."""
+    import jax
+    import jax.numpy as jnp
+
+    if deterministic or keep_prob >= 1.0:
+        return layer_fn(x)
+    keep = jax.random.bernoulli(rng, keep_prob)
+    return jax.lax.cond(keep, layer_fn, lambda h: h, x)

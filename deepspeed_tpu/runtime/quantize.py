@@ -1,0 +1,178 @@
+"""MoQ — Mixture-of-Quantization training-time quantizer.
+
+Capability parity with reference ``deepspeed/runtime/quantize.py:14
+Quantizer`` — progressively fake-quantizes weights during training
+(high-bit → target-bit over quantize periods, optionally eigenvalue-paced),
+with symmetric/asymmetric group quantization, stochastic or nearest
+rounding, ternary/binary end states, and fp16-mix ratio blending. The
+tensor math is pure jnp (the reference's ``csrc/quantization`` fake-quant
+kernels fuse into the surrounding XLA program).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+TWO_D_PARAMS = 6
+
+
+def quantize_highbit(x: jnp.ndarray, num_bits: int, q_groups: int = 1,
+                     q_type: str = "symmetric", q_rounding: str = "nearest",
+                     rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Group fake-quantization (reference quantize_highbit)."""
+    q_range = 2 ** num_bits
+    flat = x.reshape(q_groups, -1)
+    g_min = flat.min(axis=-1, keepdims=True)
+    g_max = flat.max(axis=-1, keepdims=True)
+    if q_rounding == "stochastic" and rng is not None:
+        p = jax.random.uniform(rng, flat.shape, minval=-0.5, maxval=0.5)
+    else:
+        p = 0.0
+    if q_type == "symmetric":
+        scale = 2 * jnp.maximum(jnp.abs(g_min), jnp.abs(g_max)) / q_range
+        scale = jnp.where(scale == 0, 1.0, scale)
+        out = jnp.clip(jnp.round(flat / scale + p),
+                       -(q_range >> 1), (q_range >> 1) - 1) * scale
+    else:  # asymmetric
+        scale = (g_max - g_min) / q_range
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero_point = jnp.round(g_min / scale) * scale
+        out = jnp.clip(jnp.round((flat - zero_point) / scale + p),
+                       0, q_range - 1) * scale + zero_point
+    return out.reshape(x.shape)
+
+
+def quantize_ternary(x: jnp.ndarray, q_groups: int = 1) -> jnp.ndarray:
+    flat = x.reshape(q_groups, -1)
+    n = flat.shape[1]
+    m = jnp.sum(jnp.abs(flat), axis=1) / n
+    thres = (0.7 * m)[:, None]
+    mask = jnp.abs(flat) > thres
+    alpha = (jnp.sum(jnp.where(mask, jnp.abs(flat), 0), axis=1) /
+             jnp.maximum(jnp.sum(mask, axis=1), 1))[:, None]
+    out = jnp.where(flat > thres, alpha, 0) - jnp.where(flat < -thres, alpha, 0)
+    return out.reshape(x.shape)
+
+
+def quantize_binary(x: jnp.ndarray, q_groups: int = 1) -> jnp.ndarray:
+    flat = x.reshape(q_groups, -1)
+    n = flat.shape[1]
+    m = jnp.sum(jnp.abs(flat), axis=1, keepdims=True) / n
+    return (jnp.sign(flat) * m).reshape(x.shape)
+
+
+class Quantizer:
+    def __init__(self, q_groups: int = 1, q_mixed_fp16: bool = False,
+                 q_change_ratio: float = 0.01, q_type: str = "symmetric",
+                 q_rounding: str = "nearest", q_verbose: bool = False,
+                 q_eigenvalue: bool = False,
+                 use_quantizer_kernel: bool = False, layer_num: int = 0):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type
+        self.q_rounding = q_rounding
+        self.q_verbose = q_verbose
+        self.q_eigenvalue = q_eigenvalue
+        self.use_quantizer_kernel = use_quantizer_kernel
+        self.layer_num = layer_num
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+        # per-layer progressive state, set via quantize_settings
+        self.q_start_bits: List[int] = []
+        self.q_target_bits: int = 8
+        self.q_period: List[int] = []
+
+    def quantize_settings(self, start_bits: int, target_bits: int,
+                          period: int) -> None:
+        n = max(self.layer_num, 1)
+        self.q_start_bits = [start_bits] * n
+        self.q_target_bits = target_bits
+        self.q_period = [period] * n
+
+    def any_precision_switch(self) -> bool:
+        if self.layer_num == 0:
+            return True
+        if not self.q_start_bits:
+            self.quantize_settings(16, 8, 100)
+        for index in range(self.layer_num):
+            if self.q_start_bits[index] != self.q_target_bits:
+                next_step = self.qsteps + TWO_D_PARAMS * max(self.layer_num, 1)
+                if next_step >= self.q_period[index]:
+                    return True
+        return False
+
+    def step(self) -> None:
+        self.qsteps += 1
+
+    def update_fp16_ratio(self) -> None:
+        if self.q_mixed_fp16 and self.quantize_real_ratio > 0:
+            self.quantize_real_ratio = max(
+                0.0, self.quantize_real_ratio - self.q_change_ratio)
+
+    def compute_quantization(self, x: jnp.ndarray, layer_id: int = 0,
+                             factor: int = 1,
+                             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        """Progressive bit reduction for one tensor: when the layer's period
+        elapses (scaled by the eigenvalue ``factor``), halve the bits toward
+        the target; then fake-quantize at the current bits."""
+        if not self.q_start_bits:
+            self.quantize_settings(16, 8, 100)
+        idx = min(layer_id, len(self.q_start_bits) - 1)
+        if self.q_start_bits[idx] != self.q_target_bits and \
+                self.qsteps >= self.q_period[idx] * factor:
+            self.q_start_bits[idx] = max(self.q_target_bits,
+                                         self.q_start_bits[idx] // 2)
+            self.q_period[idx] *= 2
+            if self.q_verbose:
+                log_dist(f"MoQ: layer {idx} → "
+                         f"{self.q_start_bits[idx]} bits at step "
+                         f"{self.qsteps}", ranks=[0])
+        bits = self.q_start_bits[idx]
+        if bits == 2:
+            q = quantize_ternary(x, self.q_groups)
+        elif bits == 1:
+            q = quantize_binary(x, self.q_groups)
+        else:
+            q = quantize_highbit(x, bits, self.q_groups, self.q_type,
+                                 self.q_rounding, rng)
+        if self.q_mixed_fp16:
+            q = self.quantize_real_ratio * x + \
+                (1.0 - self.quantize_real_ratio) * q
+        return q.astype(x.dtype)
+
+    def quantize(self, param_tree: Dict, overflow: bool = False,
+                 eigenvalue_enabled: bool = False,
+                 block_eigenvalue: Optional[Dict[str, Tuple[float, int]]] = None,
+                 rng: Optional[jax.Array] = None) -> Dict:
+        """Quantize every matrix-shaped leaf of ``param_tree`` in place
+        (functionally) — reference Quantizer.quantize. ``block_eigenvalue``
+        maps param paths to (eigenvalue, layer_id)."""
+        if overflow and not eigenvalue_enabled:
+            return param_tree
+        self.step()
+        self.update_fp16_ratio()
+
+        def leaf_path(path) -> str:
+            return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+
+        def quantize_leaf(path, p):
+            if jnp.ndim(p) <= 1:
+                return p
+            key = leaf_path(path)
+            eigenvalue, layer_id = (None, 0)
+            if block_eigenvalue:
+                eigenvalue, layer_id = block_eigenvalue.get(key, (None, 0))
+            if eigenvalue is not None:
+                factor = 1 + math.floor(eigenvalue * 4)
+                return self.compute_quantization(p, layer_id, factor, rng=rng)
+            return self.compute_quantization(p, layer_id, rng=rng)
+
+        return jax.tree_util.tree_map_with_path(quantize_leaf, param_tree)

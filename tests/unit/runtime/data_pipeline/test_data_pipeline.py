@@ -1,0 +1,320 @@
+"""Data-efficiency suite — analog of reference
+``tests/unit/runtime/test_data_efficiency.py`` (curriculum + random-LTD)
+and the data_sampling tests."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler,
+)
+
+
+class TestCurriculumScheduler:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8},
+        })
+        assert s.update_difficulty(0) == 8
+        mid = s.update_difficulty(50)
+        assert 8 < mid < 64 and mid % 8 == 0
+        assert s.update_difficulty(100) == 64
+        assert s.update_difficulty(1000) == 64
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8, "root_degree": 2},
+        })
+        # sqrt schedule rises faster early than linear
+        lin = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8},
+        })
+        assert s.update_difficulty(25) >= lin.update_difficulty(25)
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 2, "max_difficulty": 10,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [2, 4, 10],
+                                "max_step": [5, 10]},
+        })
+        assert s.update_difficulty(3) == 2
+        assert s.update_difficulty(7) == 4
+        assert s.update_difficulty(11) == 10
+
+    def test_custom(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 1, "max_difficulty": 100,
+            "schedule_type": "custom",
+        })
+        s.set_custom_get_difficulty(lambda step: min(step * 2, 100))
+        assert s.update_difficulty(10) == 20
+
+    def test_state_roundtrip(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8},
+        })
+        s.update_difficulty(42)
+        sd = s.state_dict()
+        s2 = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8},
+        })
+        s2.load_state_dict(sd)
+        assert s2.get_current_difficulty() == s.get_current_difficulty()
+
+
+class TestIndexedDataset:
+    def test_roundtrip(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+            MMapIndexedDataset,
+            MMapIndexedDatasetBuilder,
+        )
+
+        prefix = str(tmp_path / "corpus")
+        builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+        docs = [np.arange(n, dtype=np.int32) for n in (3, 7, 1, 12)]
+        for d in docs:
+            builder.add_item(d)
+        builder.finalize()
+
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 4
+        for i, d in enumerate(docs):
+            np.testing.assert_array_equal(ds[i], d)
+        np.testing.assert_array_equal(ds.sizes, [3, 7, 1, 12])
+        # partial read
+        np.testing.assert_array_equal(ds.get(3, offset=2, length=4),
+                                      [2, 3, 4, 5])
+        assert MMapIndexedDataset.exists(prefix)
+
+
+class TestDataSampler:
+    def _sampler(self, metric_values, difficulty_type="value"):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+            DeepSpeedDataSampler,
+        )
+
+        cfg = {
+            "curriculum_learning": {
+                "enabled": True,
+                "curriculum_metrics": {
+                    "seqlen": {
+                        "min_difficulty": 2, "max_difficulty": 100,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 10,
+                                            "difficulty_step": 2},
+                        "difficulty_type": difficulty_type,
+                    }
+                },
+            }
+        }
+        return DeepSpeedDataSampler(
+            cfg, one_epoch_total_samples=len(metric_values),
+            micro_batch_size=2, data_parallel_rank=0, data_parallel_size=2,
+            metric_values={"seqlen": metric_values})
+
+    def test_early_batches_are_easy(self):
+        values = np.arange(100)  # difficulty == index
+        sampler = self._sampler(values)
+        it = iter(sampler)
+        first = next(it)
+        assert all(values[i] <= 4 for i in first), first
+
+    def test_difficulty_grows(self):
+        values = np.arange(100)
+        sampler = self._sampler(values)
+        batch = None
+        for _ in range(2):  # difficulty carries across epochs
+            for batch in sampler:
+                pass
+        assert any(values[i] > 10 for i in batch) or \
+            sampler.current_difficulties["seqlen"] == 100
+
+    def test_epoch_length(self):
+        values = np.arange(10)
+        sampler = self._sampler(values)  # global batch = 2*2 = 4
+        assert len(list(iter(sampler))) == 2  # drop_last floors 10/4
+        sampler.drop_last = False
+        assert len(list(iter(sampler))) == 3
+
+    def test_state_roundtrip(self):
+        values = np.arange(50)
+        sampler = self._sampler(values)
+        it = iter(sampler)
+        for _ in range(3):
+            next(it)
+        sd = sampler.state_dict()
+        sampler2 = self._sampler(values)
+        sampler2.load_state_dict(sd)
+        assert sampler2.consumed_samples == sampler.consumed_samples
+        np.testing.assert_array_equal(next(iter(sampler2)), next(it))
+
+
+class TestRandomLTD:
+    def test_sample_tokens_sorted_unique(self):
+        import jax
+
+        from deepspeed_tpu.ops.random_ltd import sample_tokens
+
+        idx = sample_tokens(jax.random.PRNGKey(0), batch=4, seq_length=16,
+                            reserved_length=8)
+        assert idx.shape == (4, 8)
+        idx = np.asarray(idx)
+        for row in idx:
+            assert (np.diff(row) > 0).all(), row  # sorted & unique
+            assert row.min() >= 0 and row.max() < 16
+
+    def test_gather_scatter_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.ops.random_ltd import (
+            gather_tokens,
+            sample_tokens,
+            scatter_tokens,
+        )
+
+        x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+        idx = sample_tokens(jax.random.PRNGKey(1), 2, 8, 3)
+        part = gather_tokens(x, idx)
+        assert part.shape == (2, 3, 4)
+        out = scatter_tokens(x, part * 0, idx)
+        # selected positions zeroed, others untouched
+        out = np.asarray(out)
+        xn = np.asarray(x)
+        for b in range(2):
+            for s in range(8):
+                if s in np.asarray(idx)[b]:
+                    assert (out[b, s] == 0).all()
+                else:
+                    np.testing.assert_array_equal(out[b, s], xn[b, s])
+
+    def test_random_layer_token_drop_module(self):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+            RandomLayerTokenDrop,
+        )
+
+        layer = nn.Dense(4)
+        wrapped = RandomLayerTokenDrop(layer=layer)
+        x = jnp.ones((2, 8, 4))
+        params = wrapped.init(
+            {"params": jax.random.PRNGKey(0),
+             "random_ltd": jax.random.PRNGKey(1)}, x, reserved_length=4)
+        out = wrapped.apply(params, x, reserved_length=4,
+                            rngs={"random_ltd": jax.random.PRNGKey(2)})
+        assert out.shape == x.shape
+        # deterministic mode = plain layer
+        out_det = wrapped.apply(params, x, deterministic=True)
+        assert out_det.shape == x.shape
+
+    def test_token_drop_gathers_attention_mask(self):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+            RandomLayerTokenDrop,
+        )
+
+        class MaskChecker(nn.Module):
+            @nn.compact
+            def __call__(self, h, attention_mask=None):
+                assert attention_mask is not None
+                assert attention_mask.shape[-1] == h.shape[1], \
+                    (attention_mask.shape, h.shape)
+                return h
+
+        wrapped = RandomLayerTokenDrop(layer=MaskChecker())
+        x = jnp.ones((2, 8, 4))
+        mask2d = jnp.ones((2, 8))
+        mask4d = jnp.ones((2, 1, 8, 8))
+        rngs = {"params": jax.random.PRNGKey(0),
+                "random_ltd": jax.random.PRNGKey(1)}
+        params = wrapped.init(rngs, x, reserved_length=4,
+                              attention_mask=mask2d)
+        out = wrapped.apply(params, x, reserved_length=4,
+                            attention_mask=mask2d,
+                            rngs={"random_ltd": jax.random.PRNGKey(2)})
+        assert out.shape == x.shape
+        out = wrapped.apply(params, x, reserved_length=4,
+                            attention_mask=mask4d,
+                            rngs={"random_ltd": jax.random.PRNGKey(2)})
+        assert out.shape == x.shape
+
+    def test_scheduler(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+            RandomLTDScheduler,
+        )
+
+        s = RandomLTDScheduler({
+            "random_ltd_schedule": {
+                "min_value": 16, "max_value": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"require_steps": 10, "seq_per_step": 8},
+            }
+        })
+        assert s.update_seq(0) == 16
+        assert s.update_seq(10) == 64
+        v = s.update_seq(5)
+        assert 16 <= v <= 64 and v % 8 == 0
+
+
+def test_engine_curriculum_seqlen_truncation():
+    """Curriculum seqlen truncates the batch early in training."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as ds
+
+    seen_lens = []
+
+    class LenProbe(nn.Module):
+        @nn.compact
+        def __call__(self, batch, deterministic=True):
+            x = batch["input_ids"]
+            seen_lens.append(x.shape[1])
+            h = nn.Embed(50, 8)(x)
+            return jnp.mean(h ** 2)
+
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen",
+            "min_difficulty": 4, "max_difficulty": 16,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 4},
+        },
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = ds.initialize(model=LenProbe(), config=config)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"input_ids": rng.integers(
+            0, 50, (engine.train_batch_size(), 16)).astype(np.int32)}
+
+    for _ in range(6):
+        engine.train_batch(batch=batch())
+    assert min(seen_lens) <= 8, seen_lens   # truncated early
+    assert max(seen_lens) == 16, seen_lens  # full length by the end

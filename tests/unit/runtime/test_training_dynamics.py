@@ -1,0 +1,154 @@
+"""PLD / eigenvalue / MoQ quantizer / sparse tensor — analogs of reference
+tests for runtime training-dynamics features."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestProgressiveLayerDrop:
+    def test_theta_schedule(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            ProgressiveLayerDrop,
+        )
+
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta() == 1.0
+        pld.update_state(0)
+        assert np.isclose(pld.get_theta(), 1.0)
+        pld.update_state(1000)
+        assert 0.5 <= pld.get_theta() < 0.55
+        state = pld.get_state()
+        assert state["progressive_layer_drop"] is True
+
+    def test_keep_prob_depth_gradient(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import pld_keep_prob
+
+        probs = [pld_keep_prob(0.5, i, 10) for i in range(10)]
+        assert probs[0] > probs[-1]
+        assert np.isclose(probs[-1], 0.5)
+
+    def test_maybe_drop_layer(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            maybe_drop_layer,
+        )
+
+        x = jnp.ones((4,))
+        fn = lambda h: h * 2
+        # deterministic → always runs
+        out = maybe_drop_layer(jax.random.PRNGKey(0), 0.1, x, fn,
+                               deterministic=True)
+        np.testing.assert_array_equal(np.asarray(out), 2.0)
+        # keep_prob 0 → identity (layer skipped)
+        out = maybe_drop_layer(jax.random.PRNGKey(0), 0.0, x, fn)
+        np.testing.assert_array_equal(np.asarray(out), 1.0)
+
+
+class TestEigenvalue:
+    def test_quadratic_eigenvalue(self):
+        """loss = 0.5 * x^T diag(d) x → max Hessian eigenvalue = max(d)."""
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        d = jnp.asarray([1.0, 5.0, 3.0])
+        params = {"w": jnp.asarray([0.3, -0.2, 0.9])}
+
+        def loss(p):
+            return 0.5 * jnp.sum(d * p["w"] ** 2)
+
+        ev = Eigenvalue(max_iter=200, tol=1e-4, stability=0.0, layer_num=1)
+        results = ev.compute_eigenvalue(loss, params,
+                                        rng=jax.random.PRNGKey(0))
+        value, layer_id = results[0]
+        assert np.isclose(value, 5.0, rtol=1e-2), value
+
+    def test_block_selection(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        params = {"encoder": {"layer": {"0": {"w": jnp.ones((2, 2))},
+                                        "1": {"w": jnp.ones((2, 2))}}}}
+        ev = Eigenvalue(layer_name="encoder.layer", layer_num=2, max_iter=5)
+        assert ev.select_block(params, 0) is not None
+        assert ev.select_block(params, 1) is not None
+
+
+class TestQuantizer:
+    def test_highbit_symmetric_preserves_range(self):
+        from deepspeed_tpu.runtime.quantize import quantize_highbit
+
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((8, 16)).astype(np.float32))
+        q = quantize_highbit(x, num_bits=8, q_groups=4)
+        assert q.shape == x.shape
+        assert float(jnp.max(jnp.abs(q - x))) < 0.05  # 8-bit is close
+
+    def test_lower_bits_more_error(self):
+        from deepspeed_tpu.runtime.quantize import quantize_highbit
+
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((8, 16)).astype(np.float32))
+        e8 = float(jnp.mean((quantize_highbit(x, 8) - x) ** 2))
+        e4 = float(jnp.mean((quantize_highbit(x, 4) - x) ** 2))
+        e2 = float(jnp.mean((quantize_highbit(x, 2) - x) ** 2))
+        assert e8 < e4 < e2
+
+    def test_ternary_binary(self):
+        from deepspeed_tpu.runtime.quantize import (
+            quantize_binary,
+            quantize_ternary,
+        )
+
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((4, 8)).astype(np.float32))
+        t = np.asarray(quantize_ternary(x))
+        assert len(np.unique(np.round(np.abs(t), 5))) <= 2  # {0, alpha}
+        b = np.asarray(quantize_binary(x))
+        assert (np.abs(b) > 0).all()
+
+    def test_progressive_bit_reduction(self):
+        from deepspeed_tpu.runtime.quantize import Quantizer
+
+        q = Quantizer(q_groups=2, layer_num=1, q_verbose=False)
+        q.quantize_settings(start_bits=16, target_bits=4, period=5)
+        params = {"w": jnp.asarray(np.random.default_rng(1)
+                                   .standard_normal((4, 8))
+                                   .astype(np.float32))}
+        for _ in range(30):
+            params = q.quantize(params)
+        assert q.q_start_bits[0] == 4, q.q_start_bits
+        # values now on a coarse grid
+        u = np.unique(np.round(np.asarray(params["w"]), 6))
+        assert len(u) <= 2 ** 4 * 2 + 1, len(u)
+
+    def test_overflow_skips(self):
+        from deepspeed_tpu.runtime.quantize import Quantizer
+
+        q = Quantizer()
+        params = {"w": jnp.ones((2, 2))}
+        out = q.quantize(params, overflow=True)
+        assert out is params
+        assert q.qsteps == 0
+
+
+class TestSparseTensor:
+    def test_roundtrip(self):
+        from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+
+        dense = jnp.zeros((6, 4)).at[jnp.asarray([1, 4])].set(
+            jnp.ones((2, 4)))
+        st = SparseTensor(dense)
+        assert st.dims == (6, 4)
+        np.testing.assert_array_equal(np.asarray(st.indices), [1, 4])
+        np.testing.assert_array_equal(np.asarray(st.to_dense()),
+                                      np.asarray(dense))
+        nnz, total = st.sparse_size()
+        assert nnz < total
+
+    def test_add(self):
+        from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+
+        a = SparseTensor(jnp.zeros((4, 2)).at[0].set(1.0))
+        b = SparseTensor(jnp.zeros((4, 2)).at[2].set(2.0))
+        c = a.add(b)
+        dense = np.asarray(c.to_dense())
+        assert dense[0, 0] == 1.0 and dense[2, 0] == 2.0
