@@ -228,12 +228,53 @@ def _adagrad_factory(params: Dict) -> OptimizerDef:
     return adagrad(eps=params.get("eps", 1e-8), weight_decay=params.get("weight_decay", 0.0))
 
 
+def _onebit_adam_factory(params: Dict) -> OptimizerDef:
+    from ..runtime.fp16.onebit.adam import onebit_adam
+
+    return onebit_adam(
+        betas=tuple(params.get("betas", (0.9, 0.999))),
+        eps=params.get("eps", 1e-8),
+        weight_decay=params.get("weight_decay", 0.0),
+        freeze_step=params.get("freeze_step", 100000),
+        adam_w_mode=params.get("adam_w_mode", True),
+        bias_correction=params.get("bias_correction", True))
+
+
+def _onebit_lamb_factory(params: Dict) -> OptimizerDef:
+    from ..runtime.fp16.onebit.lamb import onebit_lamb
+
+    return onebit_lamb(
+        betas=tuple(params.get("betas", (0.9, 0.999))),
+        eps=params.get("eps", 1e-8),
+        weight_decay=params.get("weight_decay", 0.0),
+        freeze_step=params.get("freeze_step", 100000),
+        max_coeff=params.get("max_coeff", 10.0),
+        min_coeff=params.get("min_coeff", 0.01),
+        coeff_beta=params.get("coeff_beta", 0.9))
+
+
+def _zero_one_adam_factory(params: Dict) -> OptimizerDef:
+    from ..runtime.fp16.onebit.zoadam import zero_one_adam
+
+    return zero_one_adam(
+        betas=tuple(params.get("betas", (0.9, 0.999))),
+        eps=params.get("eps", 1e-8),
+        weight_decay=params.get("weight_decay", 0.0),
+        var_freeze_step=params.get("var_freeze_step", 100000),
+        var_update_scaler=params.get("var_update_scaler", 16),
+        local_step_scaler=params.get("local_step_scaler", 32678),
+        local_step_clipper=params.get("local_step_clipper", 16))
+
+
 OPTIMIZER_REGISTRY: Dict[str, Callable[[Dict], OptimizerDef]] = {
     ADAM_OPTIMIZER: _adam_factory,
     ADAMW_OPTIMIZER: _adamw_factory,
     LAMB_OPTIMIZER: _lamb_factory,
     SGD_OPTIMIZER: _sgd_factory,
     ADAGRAD_OPTIMIZER: _adagrad_factory,
+    ONEBIT_ADAM_OPTIMIZER: _onebit_adam_factory,
+    ONEBIT_LAMB_OPTIMIZER: _onebit_lamb_factory,
+    ZERO_ONE_ADAM_OPTIMIZER: _zero_one_adam_factory,
 }
 
 

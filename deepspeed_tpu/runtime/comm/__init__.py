@@ -1,0 +1,3 @@
+from .compressed import compressed_allreduce
+
+__all__ = ["compressed_allreduce"]

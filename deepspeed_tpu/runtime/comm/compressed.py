@@ -1,0 +1,97 @@
+"""Error-compensated 1-bit compressed allreduce.
+
+Capability parity with reference ``deepspeed/runtime/comm/nccl.py:54
+NcclBackend.compressed_allreduce`` (and the mpi/cupy variant,
+``runtime/comm/mpi.py:132``): the two-phase sign-compression collective
+behind 1-bit Adam/LAMB —
+
+  1. add the local worker error, split into ``world`` chunks, compress each
+     chunk to (int8 signs, fp32 per-chunk scale), remember the new worker
+     error;
+  2. ``all_to_all`` so rank *i* receives everyone's chunk *i* (the
+     reduce-scatter phase; signs travel as int8 = 4x smaller than fp32
+     — bit-packing to a true 1-bit/32x wire format is a further packing
+     step the XLA collective does not expose);
+  3. decompress + average the received chunks, add the server error,
+     re-compress, remember the new server error;
+  4. ``all_gather`` the compressed server chunks and decompress into the
+     full result.
+
+Runs inside ``shard_map`` over a named mesh axis — the int8 tensors are
+what crosses ICI/DCN. Single-device (no axis) falls back to local
+compression with error feedback, preserving the optimizer dynamics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """sign/magnitude compression over the last axis: returns
+    (int8 signs, fp32 scale) with scale = mean(|x|)."""
+    scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)
+    signs = jnp.where(x >= 0, 1, -1).astype(jnp.int8)
+    return signs, scale
+
+
+def _decompress(signs: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return signs.astype(jnp.float32) * scale
+
+
+def compressed_allreduce(
+        x: jnp.ndarray,
+        worker_error: jnp.ndarray,
+        server_error: jnp.ndarray,
+        axis_name: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (averaged_x, new_worker_error, new_server_error).
+
+    ``x``/``worker_error`` are flat fp32 vectors of length ``n`` divisible
+    by the axis size; ``server_error`` is this rank's persistent buffer of
+    length ``n // world`` (each rank only serves its own chunk — a
+    full-length buffer would waste world-fold HBM). Pad ``x`` before
+    calling; the optimizer pads its flat buffers.
+    """
+    if axis_name is None:
+        # local fallback: same compression dynamics, no communication
+        c = x + worker_error
+        signs, scale = _compress(c[None])
+        out = _decompress(signs, scale)[0]
+        new_worker = c - out
+        return out, new_worker, server_error
+
+    world = jax.lax.psum(1, axis_name)
+    n = x.shape[0]
+    chunk = n // world
+
+    # phase 1: local compression with worker error feedback
+    c = x + worker_error
+    chunks = c.reshape(world, chunk)
+    signs, scales = _compress(chunks)           # (world, chunk) int8, (world, 1)
+    new_worker_error = c - _decompress(signs, scales).reshape(n)
+
+    # phase 2: all_to_all — rank i gets every rank's chunk i
+    # (split axis 0, concat new leading axis)
+    recv_signs = jax.lax.all_to_all(signs[None], axis_name, split_axis=1,
+                                    concat_axis=0, tiled=True)
+    recv_scales = jax.lax.all_to_all(scales[None], axis_name, split_axis=1,
+                                     concat_axis=0, tiled=True)
+    # (world, chunk): row j = rank j's version of my chunk
+    decompressed = _decompress(recv_signs.reshape(world, chunk),
+                               recv_scales.reshape(world, 1))
+    server_chunk = jnp.mean(decompressed, axis=0)
+
+    # phase 3: server-side compression with server error feedback
+    sc = server_chunk + server_error
+    s_signs, s_scale = _compress(sc[None])
+    new_server_error = sc - _decompress(s_signs, s_scale)[0]
+
+    # phase 4: all_gather the compressed server chunks
+    all_signs = jax.lax.all_gather(s_signs[0], axis_name)   # (world, chunk)
+    all_scales = jax.lax.all_gather(s_scale[0], axis_name)  # (world, 1)
+    out = _decompress(all_signs, all_scales).reshape(n)
+    return out, new_worker_error, new_server_error

@@ -1,0 +1,5 @@
+from .adam import onebit_adam
+from .lamb import onebit_lamb
+from .zoadam import zero_one_adam
+
+__all__ = ["onebit_adam", "onebit_lamb", "zero_one_adam"]

@@ -1,0 +1,82 @@
+"""1-bit LAMB.
+
+Capability parity with reference ``deepspeed/runtime/fp16/onebit/lamb.py:14
+OnebitLamb`` — LAMB with error-compensated 1-bit momentum communication.
+Warmup runs full LAMB and records per-tensor scaling (trust) ratios; in the
+compression stage the momentum is sign-compressed with error feedback and
+the trust ratio is clipped to the warmup statistics via
+``coeff_beta``-smoothed bounds (the reference's frozen lamb coefficients
+with ``factor_max_frac`` clamping, simplified to its stable fixed point:
+reuse the recorded coefficient).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.optimizers import OptimizerDef, _multi_map, _tree_zeros_like
+from .adam import _compress_ef
+
+
+class OnebitLambState(NamedTuple):
+    exp_avg: Any
+    exp_avg_sq: Any
+    worker_error: Any
+    lamb_coeff: Any  # per-tensor frozen trust ratio (scalar leaves)
+
+
+def onebit_lamb(betas=(0.9, 0.999), eps: float = 1e-8,
+                weight_decay: float = 0.0, freeze_step: int = 100000,
+                max_coeff: float = 10.0, min_coeff: float = 0.01,
+                coeff_beta: float = 0.9,
+                bias_correction: bool = True) -> OptimizerDef:
+    beta1, beta2 = betas
+
+    def init(params):
+        coeff = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(1.0, jnp.float32), params)
+        return OnebitLambState(exp_avg=_tree_zeros_like(params),
+                               exp_avg_sq=_tree_zeros_like(params),
+                               worker_error=_tree_zeros_like(params),
+                               lamb_coeff=coeff)
+
+    def update(grads, state: OnebitLambState, params, lr, step):
+        t = step.astype(jnp.float32) + 1.0
+        frozen = t > freeze_step
+        bc1 = 1.0 - beta1 ** t if bias_correction else 1.0
+        bc2 = 1.0 - beta2 ** t if bias_correction else 1.0
+
+        def upd(p, g, m, v, err, coeff):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m = beta1 * m + (1.0 - beta1) * g
+            v_new = beta2 * v + (1.0 - beta2) * (g * g)
+            v = jnp.where(frozen, v, v_new)
+            m_comp, err_new = _compress_ef(m, err)
+            m = jnp.where(frozen, m_comp, m)
+            err = jnp.where(frozen, err_new, err)
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay != 0.0:
+                u = u + weight_decay * p32
+            p_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(u)
+            fresh = jnp.where((p_norm > 0) & (u_norm > 0),
+                              jnp.clip(p_norm / u_norm, min_coeff, max_coeff),
+                              1.0)
+            # warmup: smooth the coefficient estimate; frozen: reuse it
+            coeff = jnp.where(frozen, coeff,
+                              coeff_beta * coeff + (1 - coeff_beta) * fresh)
+            trust = jnp.where(frozen, coeff, fresh)
+            new_p = p32 - lr * trust * u
+            return new_p.astype(p.dtype), m, v, err, coeff
+
+        new_p, new_m, new_v, new_e, new_c = _multi_map(
+            upd, 5, params, grads, state.exp_avg, state.exp_avg_sq,
+            state.worker_error, state.lamb_coeff)
+        return new_p, OnebitLambState(exp_avg=new_m, exp_avg_sq=new_v,
+                                      worker_error=new_e, lamb_coeff=new_c)
+
+    return OptimizerDef(init=init, update=update, name="OneBitLamb")

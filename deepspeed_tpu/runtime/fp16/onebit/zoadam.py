@@ -1,0 +1,101 @@
+"""0/1 Adam.
+
+Capability parity with reference ``deepspeed/runtime/fp16/onebit/zoadam.py:13
+ZeroOneAdam`` — the 0/1 Adam algorithm: 1-bit compression with error
+feedback from step one, variance updated only at *interval* boundaries
+(interval doubling from ``var_update_scaler`` up to
+``var_freeze_step``, after which it is frozen), and learning-rate freezing
+within local-step windows. The schedule pieces (intervals) are computed from
+the step counter so the whole update stays jittable; the learning rate used
+by the update is re-latched only at local-step sync boundaries
+(``local_step_scaler`` doubling, capped at ``2^local_step_clipper`` spacing)
+— the jit-friendly rendering of 0/1 Adam's skipped synchronizations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+
+from ....ops.optimizers import OptimizerDef, _multi_map, _tree_zeros_like
+from .adam import _compress_ef
+
+
+class ZeroOneAdamState(NamedTuple):
+    exp_avg: Any
+    exp_avg_sq: Any
+    worker_error: Any
+    frozen_lr: Any  # lr latched at the last local-step sync boundary
+
+
+def zero_one_adam(betas=(0.9, 0.999), eps: float = 1e-8,
+                  weight_decay: float = 0.0, var_freeze_step: int = 100000,
+                  var_update_scaler: int = 16, local_step_scaler: int = 32678,
+                  local_step_clipper: int = 16,
+                  bias_correction: bool = True) -> OptimizerDef:
+    beta1, beta2 = betas
+
+    def init(params):
+        return ZeroOneAdamState(exp_avg=_tree_zeros_like(params),
+                                exp_avg_sq=_tree_zeros_like(params),
+                                worker_error=_tree_zeros_like(params),
+                                frozen_lr=jnp.asarray(-1.0, jnp.float32))
+
+    def _var_update_due(t):
+        """Variance updates at exponentially-spaced steps: k·2^i spacing
+        grown by var_update_scaler, until var_freeze_step."""
+        # update when floor(log2(1 + t/scaler)) changes — a doubling
+        # interval schedule that is a pure function of the step
+        k = jnp.floor(jnp.log2(1.0 + t / var_update_scaler))
+        k_prev = jnp.floor(jnp.log2(1.0 + (t - 1.0) / var_update_scaler))
+        boundary = k != k_prev
+        early = t <= var_update_scaler  # update every step at the start
+        return (early | boundary) & (t <= var_freeze_step)
+
+    def _lr_sync_due(t):
+        """Local-step boundaries: doubling spacing from local_step_scaler,
+        clipped so windows never exceed 2^local_step_clipper steps."""
+        interval_exp = jnp.minimum(
+            jnp.floor(jnp.log2(1.0 + t / local_step_scaler)),
+            float(local_step_clipper))
+        interval = jnp.exp2(interval_exp)
+        prev_interval = jnp.exp2(jnp.minimum(
+            jnp.floor(jnp.log2(1.0 + (t - 1.0) / local_step_scaler)),
+            float(local_step_clipper)))
+        return (interval != prev_interval) | (jnp.mod(t, interval) == 0)
+
+    def update(grads, state: ZeroOneAdamState, params, lr, step):
+        t = step.astype(jnp.float32) + 1.0
+        bc1 = 1.0 - beta1 ** t if bias_correction else 1.0
+        bc2 = 1.0 - beta2 ** t if bias_correction else 1.0
+        var_due = _var_update_due(t)
+        # learning-rate freezing between local-step sync boundaries
+        lr = jnp.asarray(lr, jnp.float32)
+        sync = _lr_sync_due(t) | (state.frozen_lr < 0)
+        effective_lr = jnp.where(sync, lr, state.frozen_lr)
+        new_frozen_lr = effective_lr
+
+        def upd(p, g, m, v, err):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m = beta1 * m + (1.0 - beta1) * g
+            # 0/1 Adam compresses from the start, with error feedback
+            m_comp, err = _compress_ef(m, err)
+            m = m_comp
+            v_new = beta2 * v + (1.0 - beta2) * (g * g)
+            v = jnp.where(var_due, v_new, v)
+            denom = jnp.sqrt(v / bc2) + eps
+            new_p = p32 - effective_lr * (m / bc1) / denom
+            if weight_decay != 0.0:
+                new_p = new_p - effective_lr * weight_decay * p32
+            return new_p.astype(p.dtype), m, v, err
+
+        new_p, new_m, new_v, new_e = _multi_map(
+            upd, 4, params, grads, state.exp_avg, state.exp_avg_sq,
+            state.worker_error)
+        return new_p, ZeroOneAdamState(exp_avg=new_m, exp_avg_sq=new_v,
+                                       worker_error=new_e,
+                                       frozen_lr=new_frozen_lr)
+
+    return OptimizerDef(init=init, update=update, name="ZeroOneAdam")
