@@ -58,6 +58,16 @@ def initialize(args=None,
     except ImportError:
         PipelineModule = None
 
+    cfg_probe = config
+    if isinstance(config, str):
+        import json as _json
+
+        with open(config) as _fh:
+            cfg_probe = _json.load(_fh)
+    hybrid = isinstance(cfg_probe, dict) and \
+        cfg_probe.get("hybrid_engine", {}).get("enabled", False)
+    if not hybrid and hasattr(cfg_probe, "hybrid_engine"):
+        hybrid = bool(cfg_probe.hybrid_engine.enabled)
     if PipelineModule is not None and isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine
 
@@ -66,6 +76,16 @@ def initialize(args=None,
                                 training_data=training_data,
                                 lr_scheduler=lr_scheduler, collate_fn=collate_fn,
                                 mesh=mesh, sharding_rules=sharding_rules)
+    elif hybrid:
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine = DeepSpeedHybridEngine(model=model, loss_fn=loss_fn,
+                                       model_parameters=model_parameters,
+                                       config=config,
+                                       sharding_rules=sharding_rules,
+                                       training_data=training_data,
+                                       lr_scheduler=lr_scheduler,
+                                       collate_fn=collate_fn, mesh=mesh)
     else:
         engine = DeepSpeedEngine(model=model, loss_fn=loss_fn,
                                  model_parameters=model_parameters,

@@ -1,0 +1,21 @@
+from .basic_layer import (
+    EmbeddingCompress,
+    LinearLayerCompress,
+    quantize_activation,
+    quantize_weight,
+)
+from .compress import (
+    build_compression_transform,
+    init_compression,
+    redundancy_clean,
+    student_initialization,
+)
+from .config import CompressionConfig
+from .scheduler import CompressionScheduler
+
+__all__ = [
+    "CompressionConfig", "CompressionScheduler", "init_compression",
+    "redundancy_clean", "student_initialization",
+    "build_compression_transform", "LinearLayerCompress",
+    "EmbeddingCompress", "quantize_weight", "quantize_activation",
+]

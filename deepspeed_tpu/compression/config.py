@@ -1,0 +1,77 @@
+"""Compression config parsing.
+
+Capability parity with reference ``deepspeed/compression/config.py`` +
+``constants.py`` — parses the ``compression_training`` JSON block:
+techniques (weight/activation quantization, sparse/row/head/channel
+pruning, layer_reduction), each with ``shared_parameters`` and
+``different_groups`` of {params, modules} entries. Unmodified reference
+configs must parse.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+TECHNIQUES = (
+    "weight_quantization",
+    "activation_quantization",
+    "sparse_pruning",
+    "row_pruning",
+    "head_pruning",
+    "channel_pruning",
+)
+
+
+class CompressionGroup:
+    """One ``different_groups`` entry of a technique."""
+
+    def __init__(self, technique: str, name: str, params: Dict[str, Any],
+                 modules: List[str], shared: Dict[str, Any]):
+        self.technique = technique
+        self.name = name
+        self.params = dict(params)
+        self.modules = list(modules)
+        self.shared = dict(shared)
+
+    @property
+    def schedule_offset(self) -> int:
+        return int(self.shared.get("schedule_offset", 0))
+
+    def matches(self, param_path: str) -> bool:
+        """Reference matching: module-name substring (modules=["*"] matches
+        everything)."""
+        for pattern in self.modules:
+            if pattern == "*" or pattern in param_path:
+                return True
+        return False
+
+    def __repr__(self):
+        return (f"CompressionGroup({self.technique}/{self.name}, "
+                f"modules={self.modules})")
+
+
+class CompressionConfig:
+    def __init__(self, compression_training: Dict[str, Any]):
+        self.raw = dict(compression_training or {})
+        self.groups: List[CompressionGroup] = []
+        for technique in TECHNIQUES:
+            block = self.raw.get(technique)
+            if not block:
+                continue
+            shared = dict(block.get("shared_parameters", {}))
+            if not shared.get("enabled", False):
+                continue
+            for name, group in block.get("different_groups", {}).items():
+                self.groups.append(CompressionGroup(
+                    technique, name, group.get("params", {}),
+                    group.get("modules", ["*"]), shared))
+        lr = self.raw.get("layer_reduction", {})
+        self.layer_reduction_enabled = bool(lr.get("enabled", False))
+        self.layer_reduction = dict(lr)
+
+    def technique_groups(self, technique: str) -> List[CompressionGroup]:
+        return [g for g in self.groups if g.technique == technique]
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.groups) or self.layer_reduction_enabled

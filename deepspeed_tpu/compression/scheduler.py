@@ -1,0 +1,37 @@
+"""Compression scheduler.
+
+Capability parity with reference ``deepspeed/compression/scheduler.py`` —
+tracks training steps and reports which technique groups are active. In
+this framework the schedule gating runs *inside* the compiled train step
+(jnp.where on the step counter, see compress.build_compression_transform);
+this class is the eager-side mirror for user introspection and for driving
+``redundancy_clean`` at the right moment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..utils.logging import log_dist
+from .config import CompressionConfig
+
+
+class CompressionScheduler:
+    def __init__(self, config: CompressionConfig):
+        self.config = config
+        self.training_steps = 0
+        self._announced: Dict[str, bool] = {}
+
+    def step(self, step_zero_check: bool = False) -> None:
+        self.training_steps += 1
+        for g in self.config.groups:
+            key = f"{g.technique}/{g.name}"
+            if not self._announced.get(key) and \
+                    self.training_steps >= g.schedule_offset:
+                self._announced[key] = True
+                log_dist(f"compression group {key} active from step "
+                         f"{self.training_steps}", ranks=[0])
+
+    def active_groups(self) -> List[str]:
+        return [f"{g.technique}/{g.name}" for g in self.config.groups
+                if self.training_steps >= g.schedule_offset]

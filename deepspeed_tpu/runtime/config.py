@@ -120,6 +120,18 @@ class PipelineConfig(DeepSpeedConfigModel):
     grad_partitioned: bool = True
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """``hybrid_engine`` block (reference DeepSpeedHybridEngineConfig)."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+    lora_scaling: float = 1.0  # TPU extension: LoRA fuse scale
+
+
 class MeshDims(DeepSpeedConfigModel):
     """TPU extension: degrees of parallelism for the global device mesh."""
 
@@ -205,12 +217,16 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     mesh: MeshDims = Field(default_factory=MeshDims)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     aio: AioConfig = Field(default_factory=AioConfig)
     curriculum_learning: CurriculumParams = Field(default_factory=CurriculumParams)
     eigenvalue: EigenvalueConfig = Field(default_factory=EigenvalueConfig)
+    # compression_training keeps the reference's free-form schema (parsed by
+    # compression.CompressionConfig, not pydantic)
+    compression_training: Optional[Dict[str, Any]] = None
 
     zero_allow_untested_optimizer: bool = False
     zero_force_ds_cpu_optimizer: bool = True

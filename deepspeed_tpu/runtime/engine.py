@@ -174,6 +174,23 @@ class DeepSpeedEngine:
                        verbose=cl.verbose, debug=cl.debug)
         self.checkpoint_engine = ArrayCheckpointEngine()
 
+        # compression training (reference compression/scheduler.py hooks;
+        # here the transform runs inside the compiled step)
+        self.compression_scheduler = None
+        self._compression_transform = None
+        self._jit_compression = None
+        if self._config.compression_training:
+            from ..compression import (
+                CompressionScheduler,
+                init_compression,
+            )
+
+            cc, transform = init_compression(
+                self._config.compression_training)
+            if cc.enabled:
+                self.compression_scheduler = CompressionScheduler(cc)
+                self._compression_transform = transform
+
         # curriculum learning (reference engine.py:1714-1718 seqlen
         # truncation + curriculum_scheduler.py) — bucketed difficulty keeps
         # the set of distinct shapes (and XLA compiles) small
@@ -426,6 +443,7 @@ class DeepSpeedEngine:
         param_sh = self._shardings["params"]
         prescale = self._config.prescale_gradients
         predivide = self._config.gradient_predivide_factor
+        compression_transform = self._compression_transform
 
         def constrain_grads(grads, ref):
             sh = policy.grad_shardings(ref)
@@ -508,6 +526,12 @@ class DeepSpeedEngine:
                     jax.lax.with_sharding_constraint, new_params, param_sh)
             else:
                 new_params = new_master
+
+            if compression_transform is not None:
+                # compression applies to the COMPUTE params only; the fp32
+                # master stays exact (reference quantizes the fp16 copy)
+                new_params = compression_transform(new_params,
+                                                   new_state["step"])
 
             new_state["params"] = new_params
             new_state["master"] = new_master if keep_master else None
@@ -716,11 +740,22 @@ class DeepSpeedEngine:
         new_params = self._offload_opt.step(
             grads_host, float(metrics["lr"]), step_num,
             np.dtype(self.compute_dtype))
-        self.state["params"] = jax.device_put(new_params,
-                                              self._shardings["params"])
+        params_dev = jax.device_put(new_params, self._shardings["params"])
+        if self._compression_transform is not None:
+            # the fused path compresses inside update_from_grads; the
+            # offloaded step must apply the same transform on re-upload
+            if self._jit_compression is None:
+                self._jit_compression = jax.jit(
+                    self._compression_transform,
+                    out_shardings=self._shardings["params"])
+            params_dev = self._jit_compression(params_dev,
+                                               self.state["step"])
+        self.state["params"] = params_dev
 
     def _after_step(self, metrics) -> None:
         self._last_grad_norm = metrics.get("grad_norm")
+        if self.compression_scheduler is not None:
+            self.compression_scheduler.step()
         if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
             events = [
                 ("Train/Samples/train_loss", float(metrics["loss"]), self.global_samples),

@@ -1,0 +1,200 @@
+"""Compression suite — analog of reference
+``tests/unit/compression/test_compression.py``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.compression import (
+    CompressionConfig,
+    build_compression_transform,
+    init_compression,
+    quantize_activation,
+    redundancy_clean,
+    student_initialization,
+)
+
+WQ_CONFIG = {
+    "weight_quantization": {
+        "shared_parameters": {
+            "enabled": True,
+            "schedule_offset": 5,
+            "quantize_groups": 1,
+            "quantization_type": "symmetric",
+            "rounding": "nearest",
+        },
+        "different_groups": {
+            "wq1": {
+                "params": {"start_bits": 12, "target_bits": 4,
+                           "quantization_period": 5},
+                "modules": ["linear_0"],
+            }
+        },
+    }
+}
+
+
+def test_config_parses_reference_schema():
+    cc = CompressionConfig(WQ_CONFIG)
+    assert cc.enabled
+    assert len(cc.groups) == 1
+    g = cc.groups[0]
+    assert g.technique == "weight_quantization"
+    assert g.schedule_offset == 5
+    assert g.matches("linear_0.kernel")
+    assert not g.matches("head.kernel")
+
+
+def test_weight_quantization_gated_by_schedule():
+    _, transform = init_compression({"compression_training": WQ_CONFIG})
+    params = {"linear_0": {"kernel": jnp.asarray(
+        np.random.default_rng(0).standard_normal((8, 8)).astype(np.float32))},
+        "head": {"kernel": jnp.ones((8, 8))}}
+    before = transform(params, jnp.asarray(0))
+    np.testing.assert_array_equal(np.asarray(before["linear_0"]["kernel"]),
+                                  np.asarray(params["linear_0"]["kernel"]))
+    after = transform(params, jnp.asarray(1000))
+    # matched group quantized, unmatched untouched
+    assert not np.allclose(np.asarray(after["linear_0"]["kernel"]),
+                           np.asarray(params["linear_0"]["kernel"]))
+    np.testing.assert_array_equal(np.asarray(after["head"]["kernel"]),
+                                  np.asarray(params["head"]["kernel"]))
+    # 4-bit symmetric → few distinct values
+    u = np.unique(np.round(np.asarray(after["linear_0"]["kernel"]), 5))
+    assert len(u) <= 16 + 1, len(u)
+
+
+def test_sparse_and_row_pruning():
+    cfg = {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "method": "l1"},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["dense"]}},
+        },
+        "row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "rp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["proj"]}},
+        },
+    }
+    _, transform = init_compression(cfg)
+    rng = np.random.default_rng(0)
+    params = {"dense": {"kernel": jnp.asarray(
+        rng.standard_normal((16, 16)).astype(np.float32))},
+        "proj": {"kernel": jnp.asarray(
+            rng.standard_normal((16, 16)).astype(np.float32))}}
+    out = transform(params, jnp.asarray(10))
+    sparse = np.asarray(out["dense"]["kernel"])
+    assert 0.4 <= (sparse == 0).mean() <= 0.6, (sparse == 0).mean()
+    rowpruned = np.asarray(out["proj"]["kernel"])
+    zero_cols = (rowpruned == 0).all(axis=0)
+    assert 0.4 <= zero_cols.mean() <= 0.6, zero_cols.mean()
+
+
+def test_head_pruning():
+    cfg = {
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "hp1": {"params": {"dense_ratio": 0.5, "num_heads": 4},
+                        "modules": ["attn_out"]}},
+        },
+    }
+    _, transform = init_compression(cfg)
+    rng = np.random.default_rng(0)
+    params = {"attn_out": {"kernel": jnp.asarray(
+        rng.standard_normal((16, 8)).astype(np.float32))}}
+    out = transform(params, jnp.asarray(1))
+    k = np.asarray(out["attn_out"]["kernel"])
+    # 2 of 4 head slices (4 rows each) fully zeroed
+    head_zero = [(k[h * 4:(h + 1) * 4] == 0).all() for h in range(4)]
+    assert sum(head_zero) == 2, head_zero
+
+
+def test_redundancy_clean():
+    cc, _ = init_compression({"compression_training": WQ_CONFIG})
+    params = {"linear_0": {"kernel": jnp.asarray(
+        np.random.default_rng(0).standard_normal((8, 8)).astype(np.float32))}}
+    cleaned = redundancy_clean(params, cc)
+    u = np.unique(np.round(np.asarray(cleaned["linear_0"]["kernel"]), 5))
+    assert len(u) <= 17
+
+
+def test_activation_quantization():
+    x = jnp.asarray(np.random.default_rng(0)
+                    .standard_normal((4, 32)).astype(np.float32))
+    q = quantize_activation(x, bits=8)
+    assert float(jnp.max(jnp.abs(q - x))) < 0.05
+    q4 = quantize_activation(x, bits=4, q_type="symmetric")
+    assert float(jnp.mean((q4 - x) ** 2)) > float(jnp.mean((q - x) ** 2))
+
+
+def test_student_initialization_layer_reduction():
+    def layer(seed):
+        return {"kernel": jnp.full((4, 4), float(seed))}
+
+    teacher = {"encoder": {"layer": {str(i): layer(i) for i in range(6)}},
+               "pooler": {"kernel": jnp.full((4, 4), 99.0)}}
+    student = {"encoder": {"layer": {str(i): layer(0) for i in range(3)}},
+               "pooler": {"kernel": jnp.zeros((4, 4))}}
+    out = student_initialization(student, teacher, {
+        "layer_reduction": {"enabled": True, "teacher_layer": [1, 3, 5]}})
+    assert float(out["encoder"]["layer"]["0"]["kernel"][0, 0]) == 1.0
+    assert float(out["encoder"]["layer"]["1"]["kernel"][0, 0]) == 3.0
+    assert float(out["encoder"]["layer"]["2"]["kernel"][0, 0]) == 5.0
+    assert float(out["pooler"]["kernel"][0, 0]) == 99.0
+
+
+def test_compressed_layers_forward():
+    from deepspeed_tpu.compression import (
+        EmbeddingCompress,
+        LinearLayerCompress,
+    )
+
+    lin = LinearLayerCompress(features=8, act_bits=8, weight_bits=8)
+    x = jnp.ones((2, 4))
+    params = lin.init(jax.random.PRNGKey(0), x)
+    y = lin.apply(params, x)
+    assert y.shape == (2, 8)
+
+    emb = EmbeddingCompress(num_embeddings=10, features=4, weight_bits=8)
+    ids = jnp.asarray([[1, 2], [3, 4]])
+    params = emb.init(jax.random.PRNGKey(0), ids)
+    out = emb.apply(params, ids)
+    assert out.shape == (2, 2, 4)
+
+
+def test_engine_compression_training():
+    """End-to-end: engine applies weight quantization after the offset."""
+    from tests.unit.simple_model import SimpleModel, random_batch
+
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                      "quantization_type": "symmetric"},
+                "different_groups": {
+                    "wq1": {"params": {"start_bits": 8, "target_bits": 4,
+                                       "quantization_period": 1},
+                            "modules": ["linear_0"]}},
+            }
+        },
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                                    config=config)
+    b = random_batch(engine.train_batch_size())
+    for _ in range(8):
+        engine.train_batch(batch=b)
+    k = np.asarray(jax.device_get(
+        engine.state["params"]["linear_0"]["kernel"]))
+    u = np.unique(np.round(k, 4))
+    assert len(u) <= 33, len(u)  # 4-bit quantized grid (plus blend residue)
+    assert engine.compression_scheduler.active_groups()
