@@ -1,0 +1,6 @@
+from .autotuner import Autotuner, run_autotuning
+from .config import AutotuningConfig
+from .tuner import CostModel, GridSearchTuner, ModelBasedTuner, RandomTuner
+
+__all__ = ["Autotuner", "run_autotuning", "AutotuningConfig",
+           "GridSearchTuner", "RandomTuner", "ModelBasedTuner", "CostModel"]

@@ -1,0 +1,41 @@
+"""Autotuning config.
+
+Capability parity with reference ``deepspeed/autotuning/config.py`` — the
+``autotuning`` JSON block controlling the experiment search.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+AUTOTUNING_METRIC_LATENCY = "latency"
+AUTOTUNING_METRIC_THROUGHPUT = "throughput"
+AUTOTUNING_METRIC_FLOPS = "flops"
+
+GRIDSEARCH_TUNER = "gridsearch"
+RANDOM_TUNER = "random"
+MODEL_BASED_TUNER = "model_based"
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = True
+    metric: str = AUTOTUNING_METRIC_THROUGHPUT
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    metric_path: Optional[str] = None
+    tuner_type: str = GRIDSEARCH_TUNER
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    arg_mappings: Optional[Dict[str, str]] = None
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+    max_train_micro_batch_size_per_gpu: Optional[int] = None
+    min_train_micro_batch_size_per_gpu: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    mp_size: int = 1

@@ -1,0 +1,146 @@
+"""Experiment tuners.
+
+Capability parity with reference ``deepspeed/autotuning/tuner/`` —
+``GridSearchTuner`` / ``RandomTuner`` (random_tuner.py) /
+``ModelBasedTuner`` (model_based_tuner.py with its xgboost cost model;
+xgboost is not in the TPU image, so the cost model is a least-squares
+quadratic over the numeric experiment features — same role: rank untried
+points by predicted metric and explore best-first).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+Experiment = Dict[str, Any]
+
+
+class BaseTuner:
+    def __init__(self, exps: List[Experiment],
+                 metric_fn: Callable[[Experiment], Optional[float]],
+                 early_stopping: int = 5):
+        self.all_exps = list(exps)
+        self.metric_fn = metric_fn
+        self.early_stopping = early_stopping
+        self.best_exp: Optional[Experiment] = None
+        self.best_metric: float = float("-inf")
+        self.records: List[Tuple[Experiment, Optional[float]]] = []
+
+    def _order(self) -> List[Experiment]:
+        raise NotImplementedError
+
+    def tune(self) -> Tuple[Optional[Experiment], float]:
+        stale = 0
+        for exp in self._order():
+            metric = self.metric_fn(exp)
+            self.records.append((exp, metric))
+            if metric is not None and metric > self.best_metric:
+                self.best_metric = metric
+                self.best_exp = exp
+                stale = 0
+            else:
+                stale += 1
+                if stale >= self.early_stopping:
+                    break
+        return self.best_exp, self.best_metric
+
+
+class GridSearchTuner(BaseTuner):
+    def _order(self):
+        return self.all_exps
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, *args, seed: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._rng = random.Random(seed)
+
+    def _order(self):
+        order = list(self.all_exps)
+        self._rng.shuffle(order)
+        return order
+
+
+def _features(exp: Experiment) -> List[float]:
+    feats = []
+    cfg = exp.get("ds_config", exp)
+    feats.append(float(cfg.get("train_micro_batch_size_per_gpu", 1)))
+    feats.append(float(cfg.get("gradient_accumulation_steps", 1)))
+    feats.append(float(cfg.get("zero_optimization", {}).get("stage", 0)))
+    return feats
+
+
+class CostModel:
+    """Least-squares quadratic surrogate over experiment features —
+    stands in for the reference's xgboost cost model."""
+
+    def __init__(self):
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._w = None
+
+    @staticmethod
+    def _expand(f: List[float]) -> List[float]:
+        out = [1.0] + f
+        out += [a * b for i, a in enumerate(f) for b in f[i:]]
+        return out
+
+    def fit(self, X: List[List[float]], y: List[float]) -> None:
+        self._X, self._y = X, y
+        if len(X) >= 3:
+            A = np.asarray([self._expand(f) for f in X])
+            self._w, *_ = np.linalg.lstsq(A, np.asarray(y), rcond=None)
+
+    def predict(self, f: List[float]) -> float:
+        if self._w is None:
+            return 0.0
+        return float(np.dot(self._expand(f), self._w))
+
+
+class ModelBasedTuner(BaseTuner):
+    """Explore a seed sample, fit the cost model, then try remaining points
+    best-predicted-first (reference model_based_tuner.py flow)."""
+
+    def __init__(self, *args, seed_trials: int = 3, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.seed_trials = seed_trials
+        self.cost_model = CostModel()
+
+    def tune(self):
+        stale = 0
+        pending = list(self.all_exps)
+        tried: List[Experiment] = []
+        X: List[List[float]] = []
+        y: List[float] = []
+
+        def run(exp) -> bool:
+            nonlocal stale
+            metric = self.metric_fn(exp)
+            self.records.append((exp, metric))
+            tried.append(exp)
+            if metric is not None:
+                X.append(_features(exp))
+                y.append(metric)
+            if metric is not None and metric > self.best_metric:
+                self.best_metric = metric
+                self.best_exp = exp
+                stale = 0
+                return True
+            stale += 1
+            return stale < self.early_stopping
+
+        for exp in pending[:self.seed_trials]:
+            if not run(exp):
+                return self.best_exp, self.best_metric
+        remaining = pending[self.seed_trials:]
+        while remaining:
+            self.cost_model.fit(X, y)
+            remaining.sort(key=lambda e: -self.cost_model.predict(
+                _features(e)))
+            exp = remaining.pop(0)
+            if not run(exp):
+                break
+        return self.best_exp, self.best_metric

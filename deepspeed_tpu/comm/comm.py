@@ -131,7 +131,14 @@ def get_local_rank() -> int:
 
 def _axes(group: Group) -> tuple:
     if group is None:
-        return tuple(mesh_mod.ZERO_AXES)
+        # the default group covers the full data-parallel world; under a
+        # MiCS-factored mesh that includes the replica axis (data_outer),
+        # not just the ZeRO shard axes
+        axes = tuple(mesh_mod.ZERO_AXES)
+        if mesh_mod.has_mesh() and \
+                mesh_mod.DATA_OUTER_AXIS in mesh_mod.get_mesh().axis_names:
+            axes = (mesh_mod.DATA_OUTER_AXIS,) + axes
+        return axes
     if isinstance(group, str):
         return (group,)
     return tuple(group)

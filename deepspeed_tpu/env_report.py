@@ -1,0 +1,85 @@
+"""Environment / capability report.
+
+Capability parity with reference ``deepspeed/env_report.py`` + ``bin/
+ds_report`` — prints framework, JAX/XLA, device, and native-op build
+status. Run as ``python -m deepspeed_tpu.env_report``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import shutil
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+NO = f"{RED}[NO]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+
+
+def _version(mod_name: str):
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except ImportError:
+        return None
+
+
+def op_report():
+    """Native-op availability (the analog of the reference's op-compat
+    table over op_builder)."""
+    rows = []
+    from .ops.op_builder import available_ops
+
+    for name, status in available_ops().items():
+        rows.append((name, OKAY if status else NO))
+    return rows
+
+
+def debug_report():
+    import jax
+
+    rows = [
+        ("deepspeed_tpu", _version("deepspeed_tpu") or "dev"),
+        ("jax", jax.__version__),
+        ("jaxlib", _version("jaxlib")),
+        ("flax", _version("flax")),
+        ("optax", _version("optax")),
+        ("orbax", _version("orbax.checkpoint")),
+        ("numpy", _version("numpy")),
+        ("python", sys.version.split()[0]),
+        ("platform", jax.default_backend()),
+        ("devices", ", ".join(str(d) for d in jax.devices())),
+        ("g++", shutil.which("g++") or "not found"),
+        ("XLA_FLAGS", os.environ.get("XLA_FLAGS", "")),
+    ]
+    return rows
+
+
+def main():
+    print("-" * 70)
+    print("DeepSpeed-TPU general environment info:")
+    print("-" * 70)
+    for k, v in debug_report():
+        print(f"{k:<20} {v}")
+    print("-" * 70)
+    print("native/compiled ops:")
+    print("-" * 70)
+    try:
+        for name, status in op_report():
+            print(f"{name:<20} {status}")
+    except Exception as e:
+        print(f"op report unavailable: {e}")
+    print("-" * 70)
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

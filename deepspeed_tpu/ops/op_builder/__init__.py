@@ -132,3 +132,30 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_wait.restype = ctypes.c_int64
         lib.ds_aio_pending.argtypes = [ctypes.c_void_p]
         lib.ds_aio_pending.restype = ctypes.c_int64
+
+
+ALL_OPS = {
+    CPUAdamBuilder.NAME: CPUAdamBuilder,
+    AsyncIOBuilder.NAME: AsyncIOBuilder,
+}
+
+
+def available_ops():
+    """{op name: built/compatible} — feeds ds_report (env_report.py)."""
+    out = {}
+    for name, cls in ALL_OPS.items():
+        out[name] = cls().is_compatible()
+    # Pallas kernels need no building; report them by import health
+    try:
+        from ..attention import flash_attention  # noqa: F401
+
+        out["pallas_flash_attention"] = True
+    except Exception:
+        out["pallas_flash_attention"] = False
+    try:
+        from ..sparse_attention import sparse_self_attention  # noqa: F401
+
+        out["pallas_sparse_attention"] = True
+    except Exception:
+        out["pallas_sparse_attention"] = False
+    return out

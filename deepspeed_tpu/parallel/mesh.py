@@ -36,11 +36,14 @@ from ..utils.logging import logger
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+DATA_OUTER_AXIS = "data_outer"  # MiCS replica groups (hierarchical ZeRO)
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+MICS_MESH_AXES = (PIPE_AXIS, DATA_OUTER_AXIS, DATA_AXIS, EXPERT_AXIS,
+                  SEQ_AXIS, MODEL_AXIS)
 
 # Axes over which ZeRO (sharded-DP) state is partitioned. `expert` and `seq`
 # multiply into the ZeRO shard world when enabled: params/optimizer state may
@@ -51,6 +54,15 @@ ZERO_AXES = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
 # batch axis: it shards the SEQUENCE dim of each sample (ring/Ulysses
 # attention, ops/attention/sequence_parallel.py).
 BATCH_AXES = (DATA_AXIS, EXPERT_AXIS)
+
+
+def batch_axes() -> Tuple[str, ...]:
+    """Batch (sample-dim) axes of the CURRENT mesh: includes the MiCS
+    replica axis when present."""
+    mesh = get_mesh() if has_mesh() else None
+    if mesh is not None and DATA_OUTER_AXIS in mesh.axis_names:
+        return (DATA_OUTER_AXIS,) + BATCH_AXES
+    return BATCH_AXES
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,7 +101,8 @@ def build_mesh(config: Optional[MeshConfig] = None,
                model: int = 1,
                pipe: int = 1,
                expert: int = 1,
-               seq: int = 1):
+               seq: int = 1,
+               mics_shard_size: int = 0):
     """Build the global ``jax.sharding.Mesh``.
 
     Uses ``jax.experimental.mesh_utils.create_device_mesh`` when possible so
@@ -104,13 +117,31 @@ def build_mesh(config: Optional[MeshConfig] = None,
         devices = jax.devices()
     config = config.resolve(len(devices))
 
+    mics_shard_size = int(mics_shard_size or 0)
+    dims = config.dims
+    axes = MESH_AXES
+    if mics_shard_size > config.data > 0:
+        raise ValueError(
+            f"mics_shard_size {mics_shard_size} exceeds the data-parallel "
+            f"degree {config.data}")
+    if mics_shard_size and 0 < mics_shard_size < config.data:
+        # MiCS: factor data into (replica groups × shard group); ZeRO state
+        # shards only over the inner group, replicating across groups —
+        # hierarchical allgathers stay inside a group's ICI neighborhood
+        if config.data % mics_shard_size != 0:
+            raise ValueError(
+                f"mics_shard_size {mics_shard_size} must divide data "
+                f"parallel degree {config.data}")
+        dims = (config.pipe, config.data // mics_shard_size,
+                mics_shard_size, config.expert, config.seq, config.model)
+        axes = MICS_MESH_AXES
     try:
         from jax.experimental import mesh_utils
 
-        device_array = mesh_utils.create_device_mesh(config.dims, devices=list(devices))
+        device_array = mesh_utils.create_device_mesh(dims, devices=list(devices))
     except Exception:  # non-TPU platforms (CPU test meshes) lack torus metadata
-        device_array = np.asarray(list(devices)).reshape(config.dims)
-    return Mesh(device_array, MESH_AXES)
+        device_array = np.asarray(list(devices)).reshape(dims)
+    return Mesh(device_array, axes)
 
 
 class _GroupsState:
@@ -141,7 +172,7 @@ def set_mesh(mesh) -> None:
     _state.mesh = mesh
     dims = dict(zip(mesh.axis_names, mesh.devices.shape))
     _state.mesh_config = MeshConfig(
-        data=dims.get(DATA_AXIS, 1),
+        data=dims.get(DATA_AXIS, 1) * dims.get(DATA_OUTER_AXIS, 1),
         model=dims.get(MODEL_AXIS, 1),
         pipe=dims.get(PIPE_AXIS, 1),
         expert=dims.get(EXPERT_AXIS, 1),
@@ -181,7 +212,7 @@ def get_data_parallel_world_size() -> int:
     """Number of model replicas in the batch sense — the multiplier in
     ``train_batch = micro_batch × gas × dp_world``. Excludes ``seq``: a
     sequence-parallel group cooperates on the *same* samples."""
-    return math.prod(_axis_size(a) for a in BATCH_AXES)
+    return math.prod(_axis_size(a) for a in batch_axes())
 
 
 def get_model_parallel_world_size() -> int:

@@ -120,6 +120,18 @@ class PipelineConfig(DeepSpeedConfigModel):
     grad_partitioned: bool = True
 
 
+class AutotuningBlock(DeepSpeedConfigModel):
+    """``autotuning`` block (reference autotuning/config.py) — engine-side
+    fields; the full search config lives in autotuning.AutotuningConfig."""
+
+    enabled: bool = False
+    metric: str = "throughput"
+    metric_path: Optional[str] = None
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    model_info: Dict[str, Any] = Field(default_factory=dict)
+
+
 class HybridEngineConfig(DeepSpeedConfigModel):
     """``hybrid_engine`` block (reference DeepSpeedHybridEngineConfig)."""
 
@@ -227,6 +239,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     # compression_training keeps the reference's free-form schema (parsed by
     # compression.CompressionConfig, not pydantic)
     compression_training: Optional[Dict[str, Any]] = None
+    autotuning: AutotuningBlock = Field(default_factory=AutotuningBlock)
 
     zero_allow_untested_optimizer: bool = False
     zero_force_ds_cpu_optimizer: bool = True

@@ -94,14 +94,21 @@ class DeepSpeedEngine:
         elif not mesh_mod.has_mesh():
             cfg_probe = config if isinstance(config, dict) else {}
             mesh_dims = (cfg_probe.get("mesh", {}) if isinstance(cfg_probe, dict) else {})
+            mics = (cfg_probe.get("zero_optimization", {})
+                    if isinstance(cfg_probe, dict) else {})
             mesh_mod.initialize_mesh(
                 data=mesh_dims.get("data", -1), model=mesh_dims.get("model", 1),
                 pipe=mesh_dims.get("pipe", 1), expert=mesh_dims.get("expert", 1),
-                seq=mesh_dims.get("seq", 1))
+                seq=mesh_dims.get("seq", 1),
+                mics_shard_size=max(int(mics.get("mics_shard_size", -1)), 0))
         self.mesh = mesh_mod.get_mesh()
         self.dp_world_size = mesh_mod.get_data_parallel_world_size()
         self.mp_world_size = mesh_mod.get_model_parallel_world_size()
 
+        # autotuning subprocess mode: the launcher injects the candidate
+        # config via env (reference rewrites --deepspeed_config)
+        if os.environ.get("DS_AUTOTUNING_CONFIG"):
+            config = os.environ["DS_AUTOTUNING_CONFIG"]
         if isinstance(config, DeepSpeedConfig):
             self._config = config
         else:
@@ -417,7 +424,7 @@ class DeepSpeedEngine:
         when a ``seq`` mesh axis is active — dim 1 (the sequence dim) over it
         (sequence parallelism; ring/Ulysses attention consumes that layout)."""
         entries = [None] if scan_dim else []
-        entries.append(tuple(mesh_mod.BATCH_AXES))
+        entries.append(tuple(mesh_mod.batch_axes()))
         if mesh_mod.get_sequence_parallel_world_size() > 1 and ndim > len(entries):
             entries.append(mesh_mod.SEQ_AXIS)
         return NamedSharding(self.mesh, PartitionSpec(*entries))
@@ -756,6 +763,31 @@ class DeepSpeedEngine:
         self._last_grad_norm = metrics.get("grad_norm")
         if self.compression_scheduler is not None:
             self.compression_scheduler.step()
+        at = self._config.autotuning
+        if at.enabled and at.metric_path:
+            if self.global_steps == at.start_profile_step:
+                jax.block_until_ready(metrics["loss"])
+                self._autotuning_t0 = time.perf_counter()
+            elif self.global_steps >= at.end_profile_step and \
+                    getattr(self, "_autotuning_t0", None) is not None:
+                jax.block_until_ready(metrics["loss"])
+                elapsed = time.perf_counter() - self._autotuning_t0
+                steps = self.global_steps - at.start_profile_step
+                import json as _json
+
+                with open(at.metric_path, "w") as f:
+                    _json.dump({
+                        "throughput": steps * self.train_batch_size() /
+                        max(elapsed, 1e-9),
+                        "latency": elapsed / max(steps, 1),
+                        "steps": steps,
+                    }, f)
+                self._autotuning_t0 = None
+                if os.environ.get("DS_AUTOTUNING_EXIT"):
+                    # experiment mode: the profile window is the whole job
+                    log_dist("autotuning profile window complete; exiting",
+                             ranks=[0])
+                    raise SystemExit(0)
         if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
             events = [
                 ("Train/Samples/train_loss", float(metrics["loss"]), self.global_samples),

@@ -1,0 +1,56 @@
+"""MiCS — hierarchical (sub-group) ZeRO sharding.
+
+Capability parity with reference ``deepspeed/runtime/zero/mics.py`` —
+``MiCS_Init`` (:54) shards within fixed-size sub-groups instead of the
+whole world, ``MiCS_Offload`` (:306) composes with offload, and
+``MiCS_Optimizer`` (:350) all-reduces grads across replica groups.
+
+TPU-native: MiCS is a MESH SHAPE, not an optimizer subclass. The data axis
+is factored into ``data_outer`` (replica groups) × ``data`` (the shard
+group of ``mics_shard_size`` chips): ZeRO state shards over the inner axis
+only, so parameter all-gathers stay inside one group's ICI neighborhood —
+the reference's hierarchical allgather (:226) — and GSPMD's gradient psum
+over both axes reproduces the replica-group all-reduce (:418). Configure
+with ``zero_optimization.mics_shard_size`` (the engine builds the factored
+mesh automatically) or use :func:`MiCS_Init` to build the mesh explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...parallel import mesh as mesh_mod
+from ...utils.logging import log_dist
+
+
+def MiCS_Init(shard_size: int, data: int = -1, model: int = 1, pipe: int = 1,
+              expert: int = 1, seq: int = 1, devices=None):
+    """Build and install the MiCS-factored mesh. Returns the mesh.
+
+    ≅ reference ``zero.MiCS_Init(partition_size=...)`` as a context for
+    model construction; on TPU construction needs no context manager —
+    params are sharded by the engine's policy against this mesh.
+    """
+    mesh = mesh_mod.initialize_mesh(
+        data=data, model=model, pipe=pipe, expert=expert, seq=seq,
+        mics_shard_size=shard_size, devices=devices)
+    dims = dict(zip(mesh.axis_names, mesh.devices.shape))
+    log_dist(
+        f"MiCS: shard group={dims.get(mesh_mod.DATA_AXIS, 1)} chips, "
+        f"replica groups={dims.get(mesh_mod.DATA_OUTER_AXIS, 1)}",
+        ranks=[0])
+    return mesh
+
+
+def mics_enabled() -> bool:
+    if not mesh_mod.has_mesh():
+        return False
+    return mesh_mod.DATA_OUTER_AXIS in mesh_mod.get_mesh().axis_names
+
+
+def mics_shard_size() -> Optional[int]:
+    if not mics_enabled():
+        return None
+    mesh = mesh_mod.get_mesh()
+    return dict(zip(mesh.axis_names,
+                    mesh.devices.shape))[mesh_mod.DATA_AXIS]

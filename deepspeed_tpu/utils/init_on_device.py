@@ -1,0 +1,72 @@
+"""Deferred / device-targeted initialization context.
+
+Capability parity with reference ``deepspeed/utils/init_on_device.py:12
+OnDevice`` — construct a model "on meta" (shapes only, no memory) or on a
+chosen device/dtype. JAX equivalents: ``device="meta"`` wraps
+``jax.eval_shape`` (abstract init — the flax idiom for huge models whose
+real params come from a checkpoint); a concrete device pins
+``jax.default_device``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+
+class OnDevice:
+    """with OnDevice(dtype=jnp.bfloat16, device="meta"): params = init(...)
+
+    * ``device="meta"`` — exposes :meth:`abstract_init`; inside the context
+      ``init(module, *args)`` returns shape/dtype structs with zero memory.
+    * other device — params created inside land on that device.
+    """
+
+    _active: Optional["OnDevice"] = None
+
+    def __init__(self, dtype=None, device: Any = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._ctx = None
+
+    def __enter__(self):
+        OnDevice._active = self
+        if self.enabled and self.device not in (None, "meta"):
+            import jax
+
+            self._ctx = jax.default_device(self.device)
+            self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._active = None
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)
+            self._ctx = None
+        return False
+
+    # -- meta-mode helpers -------------------------------------------------
+    def abstract_init(self, module, *args, rngs=None, **kwargs):
+        """Shapes-only init (zero device memory) — usable to build
+        shardings / checkpoint restore targets for models too big to
+        materialize."""
+        import jax
+
+        rngs = rngs or {"params": jax.random.PRNGKey(0)}
+
+        def go(*a, **kw):
+            return module.init(rngs, *a, **kw)
+
+        out = jax.eval_shape(go, *args, **kwargs)
+        if self.dtype is not None:
+            out = jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape, self.dtype) if hasattr(s, "shape") else s, out)
+        return out
+
+
+def on_device_init(module, *args, dtype=None, **kwargs):
+    """One-shot helper: abstract (meta) init of a flax module."""
+    with OnDevice(dtype=dtype, device="meta") as ctx:
+        return ctx.abstract_init(module, *args, **kwargs)

@@ -1,0 +1,136 @@
+"""Debug/introspection access to sharded training state.
+
+Capability parity with reference ``deepspeed/utils/tensor_fragment.py`` —
+the ``safe_get_full_*`` / ``safe_set_full_*`` APIs (:48,:91,:107,:124) that
+give users whole-tensor views of ZeRO-partitioned params, grads and
+optimizer state regardless of sharding. Under GSPMD a "fragment" is just a
+shard of a ``jax.Array``; ``jax.device_get`` assembles the full logical
+tensor, and ``device_put`` against the engine's shardings re-partitions on
+set. The fragment *address map* the reference needs (tensor_fragment.py:144
+``get_hp_fragment_mapping``) is carried by the array's sharding itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _lookup(tree: Any, path: str):
+    node = tree
+    for part in path.replace(".", "/").split("/"):
+        if node is None:
+            return None
+        if isinstance(node, dict):
+            node = node.get(part)
+        else:
+            node = getattr(node, part, None)
+    return node
+
+
+def _set(tree: Any, path: str, value) -> bool:
+    parts = path.replace(".", "/").split("/")
+    node = tree
+    for part in parts[:-1]:
+        if not isinstance(node, dict) or part not in node:
+            return False
+        node = node[part]
+    if isinstance(node, dict) and parts[-1] in node:
+        node[parts[-1]] = value
+        return True
+    return False
+
+
+def safe_get_full_fp32_param(engine, param_path: str) -> Optional[np.ndarray]:
+    """Full fp32 master weights of one param (reference :48)."""
+    import jax
+
+    if engine.state is None:
+        return None
+    if engine._offload_opt is not None:
+        # under offload the fp32 master lives host-side; the device params
+        # are the downcast compute copy — never return those as "fp32"
+        key = param_path.replace(".", "/")
+        flat = engine._offload_opt.master.get(key)
+        if flat is not None:
+            shape = engine._offload_opt._shapes[key]
+            return np.asarray(flat, np.float32).reshape(shape)
+    source = engine.state.get("master") or engine.state["params"]
+    leaf = _lookup(source, param_path)
+    return None if leaf is None else \
+        np.asarray(jax.device_get(leaf), np.float32)
+
+
+def safe_get_full_grad(engine, param_path: str) -> Optional[np.ndarray]:
+    """Full gradient from the eager-path accumulator (reference :91). The
+    fused train_batch consumes grads inside the compiled step — use the
+    forward/backward API when grads must be inspected."""
+    import jax
+
+    if engine._grad_acc is None:
+        return None
+    leaf = _lookup(engine._grad_acc, param_path)
+    return None if leaf is None else np.asarray(jax.device_get(leaf))
+
+
+def safe_get_full_optimizer_state(engine, param_path: str,
+                                  optim_state_key: str
+                                  ) -> Optional[np.ndarray]:
+    """Full optimizer moment for one param (reference :107).
+    ``optim_state_key``: exp_avg | exp_avg_sq."""
+    import jax
+
+    if engine._offload_opt is not None:
+        store = {"exp_avg": engine._offload_opt.m,
+                 "exp_avg_sq": engine._offload_opt.v}.get(optim_state_key)
+        if store is None:
+            return None
+        flat = store.get(param_path.replace(".", "/"))
+        if flat is None:
+            return None
+        shape = engine._offload_opt._shapes[param_path.replace(".", "/")]
+        return np.asarray(flat, np.float32).reshape(shape)
+    if engine.state is None or engine.state.get("opt_state") is None:
+        return None
+    opt = engine.state["opt_state"]
+    sub = getattr(opt, optim_state_key, None)
+    if sub is None and hasattr(opt, "_asdict"):
+        sub = opt._asdict().get(optim_state_key)
+    if sub is None:
+        return None
+    leaf = _lookup(sub, param_path)
+    return None if leaf is None else np.asarray(jax.device_get(leaf))
+
+
+def safe_set_full_fp32_param(engine, param_path: str, value) -> bool:
+    """Overwrite one param's master (and compute) weights (reference
+    :124 set API)."""
+    import jax
+    import jax.numpy as jnp
+
+    if engine.state is None:
+        return False
+    host_master = jax.device_get(engine.state.get("master")) \
+        if engine.state.get("master") is not None else None
+    host_params = jax.device_get(engine.state["params"])
+    ok = False
+    if host_master is not None and _set(host_master, param_path,
+                                        np.asarray(value, np.float32)):
+        engine.state["master"] = jax.device_put(
+            host_master, engine._shardings["master"])
+        ok = True
+    leaf = _lookup(host_params, param_path)
+    if leaf is not None:
+        cast = np.asarray(value).astype(np.asarray(leaf).dtype)
+        if _set(host_params, param_path, cast):
+            engine.state["params"] = jax.device_put(
+                host_params, engine._shardings["params"])
+            ok = True
+    if engine._offload_opt is not None:
+        key = param_path.replace(".", "/")
+        if key in engine._offload_opt.master:
+            engine._offload_opt.master[key] = np.ascontiguousarray(
+                np.asarray(value, np.float32))
+            ok = True
+    return ok

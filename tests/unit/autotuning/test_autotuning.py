@@ -1,0 +1,157 @@
+"""Autotuner suite — analog of reference ``tests/unit/autotuning/``."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (
+    Autotuner,
+    CostModel,
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+)
+
+
+def _exps(n=6):
+    return [{"name": f"e{i}",
+             "ds_config": {"train_micro_batch_size_per_gpu": 2 ** i,
+                           "zero_optimization": {"stage": i % 4}}}
+            for i in range(n)]
+
+
+class TestTuners:
+    def test_gridsearch_finds_best(self):
+        scores = {f"e{i}": float(i) for i in range(6)}
+        t = GridSearchTuner(_exps(), lambda e: scores[e["name"]],
+                            early_stopping=10)
+        best, metric = t.tune()
+        assert best["name"] == "e5" and metric == 5.0
+
+    def test_early_stopping(self):
+        calls = []
+
+        def metric(e):
+            calls.append(e["name"])
+            return 10.0 if e["name"] == "e0" else 0.0
+
+        t = GridSearchTuner(_exps(), metric, early_stopping=2)
+        best, _ = t.tune()
+        assert best["name"] == "e0"
+        assert len(calls) == 3  # e0 + 2 stale
+
+    def test_random_tuner_deterministic_seed(self):
+        scores = {f"e{i}": float(i) for i in range(6)}
+        t1 = RandomTuner(_exps(), lambda e: scores[e["name"]],
+                         early_stopping=10, seed=3)
+        t2 = RandomTuner(_exps(), lambda e: scores[e["name"]],
+                         early_stopping=10, seed=3)
+        b1, _ = t1.tune()
+        b2, _ = t2.tune()
+        assert b1["name"] == b2["name"] == "e5"
+        # same seed → same visit order
+        assert [r[0]["name"] for r in t1.records] == \
+            [r[0]["name"] for r in t2.records]
+
+    def test_model_based_tuner(self):
+        # metric peaked at mbs=8 → surrogate should still find the max
+        def metric(e):
+            mbs = e["ds_config"]["train_micro_batch_size_per_gpu"]
+            return -abs(mbs - 8)
+
+        t = ModelBasedTuner(_exps(), metric, early_stopping=10,
+                            seed_trials=3)
+        best, m = t.tune()
+        assert best["ds_config"]["train_micro_batch_size_per_gpu"] == 8
+
+    def test_cost_model_fits_quadratic(self):
+        cm = CostModel()
+        X = [[float(i), 1.0, 0.0] for i in range(8)]
+        y = [-(i - 4.0) ** 2 for i in range(8)]
+        cm.fit(X, y)
+        preds = [cm.predict([float(i), 1.0, 0.0]) for i in range(8)]
+        assert int(np.argmax(preds)) == 4
+
+
+class TestAutotunerInProcess:
+    def _factories(self):
+        from tests.unit.simple_model import SimpleModel
+
+        def model_factory():
+            return SimpleModel(hidden_dim=16)
+
+        def batch_factory(batch_size):
+            rng = np.random.default_rng(0)
+            return {"x": rng.standard_normal((batch_size, 16),
+                                             dtype=np.float32),
+                    "y": rng.standard_normal((batch_size,),
+                                             dtype=np.float32)}
+
+        return model_factory, batch_factory
+
+    def test_generate_experiments_grid(self):
+        mf, bf = self._factories()
+        at = Autotuner(mf, bf,
+                       base_config={"optimizer": {"type": "Adam",
+                                                  "params": {"lr": 1e-3}}},
+                       autotuning_config={
+                           "num_tuning_micro_batch_sizes": 2,
+                           "max_train_micro_batch_size_per_gpu": 4})
+        exps = at._generate_experiments()
+        assert len(exps) == 4 * 2
+        stages = {e["ds_config"]["zero_optimization"]["stage"] for e in exps}
+        assert stages == {0, 1, 2, 3}
+
+    def test_model_info(self):
+        mf, bf = self._factories()
+        at = Autotuner(mf, bf)
+        info = at.model_info()
+        assert info["num_params"] > 0
+        assert info["param_mem_per_stage"][3] < \
+            info["param_mem_per_stage"][0]
+
+    def test_tune_end_to_end(self, tmp_path):
+        mf, bf = self._factories()
+        at = Autotuner(
+            mf, bf,
+            base_config={"optimizer": {"type": "Adam",
+                                       "params": {"lr": 1e-3}},
+                         "steps_per_print": 1000},
+            autotuning_config={
+                "num_tuning_micro_batch_sizes": 2,
+                "max_train_micro_batch_size_per_gpu": 8,
+                "start_profile_step": 1, "end_profile_step": 3,
+                "results_dir": str(tmp_path / "results")})
+        best = at.tune(stages=[0, 1])
+        assert best and "ds_config" in best
+        assert os.path.exists(tmp_path / "results" /
+                              "autotuning_results.json")
+        assert os.path.exists(tmp_path / "results" / "best_config.json")
+        with open(tmp_path / "results" / "best_config.json") as f:
+            cfg = json.load(f)
+        assert "train_micro_batch_size_per_gpu" in cfg
+
+
+def test_engine_writes_metric_file(tmp_path):
+    import deepspeed_tpu as ds
+    from tests.unit.simple_model import SimpleModel, random_batch
+
+    metric_path = str(tmp_path / "metric.json")
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "autotuning": {"enabled": True, "metric_path": metric_path,
+                       "start_profile_step": 1, "end_profile_step": 3},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                                    config=config)
+    b = random_batch(engine.train_batch_size())
+    for _ in range(4):
+        engine.train_batch(batch=b)
+    with open(metric_path) as f:
+        m = json.load(f)
+    assert m["throughput"] > 0
+    assert m["steps"] == 2

@@ -1,0 +1,198 @@
+"""OnDevice, tensor-fragment APIs, state-dict factory, env report —
+analogs of reference ``tests/unit/utils/`` + ``test_sd_loader``-style
+coverage."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+
+
+class TestOnDevice:
+    def test_meta_init_no_memory(self):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.utils.init_on_device import OnDevice
+
+        class Big(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(128)(x)
+
+        with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+            shapes = ctx.abstract_init(Big(), jnp.ones((1, 64)))
+        kernel = shapes["params"]["Dense_0"]["kernel"]
+        assert isinstance(kernel, jax.ShapeDtypeStruct)
+        assert kernel.shape == (64, 128)
+        assert kernel.dtype == jnp.bfloat16
+
+    def test_concrete_device(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.utils.init_on_device import OnDevice
+
+        dev = jax.devices()[1]
+        with OnDevice(device=dev):
+            x = jnp.ones((4,))
+        assert list(x.devices())[0] == dev
+
+
+class TestTensorFragment:
+    def _engine(self, offload=False):
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+        from tests.unit.simple_model import SimpleModel
+
+        mesh_mod.reset_mesh()
+        config = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "bf16": {"enabled": True},
+            "steps_per_print": 1000,
+        }
+        if offload:
+            config["zero_optimization"]["offload_optimizer"] = \
+                {"device": "cpu"}
+        engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                                        config=config)
+        return engine
+
+    def test_safe_get_param_and_opt_state(self):
+        from deepspeed_tpu.utils.tensor_fragment import (
+            safe_get_full_fp32_param,
+            safe_get_full_optimizer_state,
+        )
+        from tests.unit.simple_model import random_batch
+
+        engine = self._engine()
+        b = random_batch(engine.train_batch_size())
+        for _ in range(2):
+            engine.train_batch(batch=b)
+        p = safe_get_full_fp32_param(engine, "linear_0.kernel")
+        assert p is not None and p.dtype == np.float32
+        assert p.shape == (16, 16)
+        m = safe_get_full_optimizer_state(engine, "linear_0.kernel",
+                                          "exp_avg")
+        assert m is not None and np.abs(m).sum() > 0
+
+    def test_safe_get_grad_eager_path(self):
+        from deepspeed_tpu.utils.tensor_fragment import safe_get_full_grad
+        from tests.unit.simple_model import random_batch
+
+        engine = self._engine()
+        b = random_batch(engine.train_batch_size())
+        assert safe_get_full_grad(engine, "linear_0.kernel") is None
+        loss = engine.forward(b)
+        engine.backward(loss)
+        g = safe_get_full_grad(engine, "linear_0.kernel")
+        assert g is not None and np.abs(g).sum() > 0
+        engine.step()
+
+    def test_safe_set_param(self):
+        from deepspeed_tpu.utils.tensor_fragment import (
+            safe_get_full_fp32_param,
+            safe_set_full_fp32_param,
+        )
+        from tests.unit.simple_model import random_batch
+
+        engine = self._engine()
+        engine.train_batch(batch=random_batch(engine.train_batch_size()))
+        new = np.full((16, 16), 0.5, np.float32)
+        assert safe_set_full_fp32_param(engine, "linear_0.kernel", new)
+        got = safe_get_full_fp32_param(engine, "linear_0.kernel")
+        np.testing.assert_allclose(got, new)
+
+    def test_offload_paths(self):
+        from deepspeed_tpu.utils.tensor_fragment import (
+            safe_get_full_fp32_param,
+            safe_get_full_optimizer_state,
+        )
+        from tests.unit.simple_model import random_batch
+
+        engine = self._engine(offload=True)
+        b = random_batch(engine.train_batch_size())
+        for _ in range(2):
+            engine.train_batch(batch=b)
+        p = safe_get_full_fp32_param(engine, "linear_0.kernel")
+        assert p is not None and p.shape == (16, 16)
+        m = safe_get_full_optimizer_state(engine, "linear_0.kernel",
+                                          "exp_avg")
+        assert m is not None and m.shape == (16, 16)
+
+
+class TestSDLoader:
+    def _make_shards(self, tmp_path, n=2, hidden=8, version=2.0):
+        rng = np.random.default_rng(0)
+        paths = []
+        for i in range(n):
+            sd = {
+                "attention.query_key_value.weight":
+                    rng.standard_normal((3 * hidden // n, hidden))
+                    .astype(np.float32),
+                "attention.dense.weight":
+                    rng.standard_normal((hidden, hidden // n))
+                    .astype(np.float32),
+                "mlp.dense_h_to_4h.weight":
+                    rng.standard_normal((4 * hidden // n, hidden))
+                    .astype(np.float32),
+                "input_layernorm.weight": np.ones(hidden, np.float32),
+            }
+            p = str(tmp_path / f"shard{i}.npz")
+            np.savez(p, **sd)
+            paths.append(p)
+        return paths
+
+    def test_identity_load(self, tmp_path):
+        from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+
+        paths = self._make_shards(tmp_path)
+        loader = MegatronSDLoader(paths, version=2.0)
+        sd = loader.load(mp_world_size=2, mp_rank=1)
+        assert sd["attention.dense.weight"].shape == (8, 4)
+
+    def test_merge(self, tmp_path):
+        from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+
+        paths = self._make_shards(tmp_path)
+        loader = MegatronSDLoader(paths, version=2.0)
+        sd = loader.load(mp_world_size=1, mp_rank=0)
+        assert sd["attention.query_key_value.weight"].shape == (24, 8)
+        assert sd["attention.dense.weight"].shape == (8, 8)
+        assert sd["input_layernorm.weight"].shape == (8,)
+
+    def test_split(self, tmp_path):
+        from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+
+        paths = self._make_shards(tmp_path, n=1)
+        loader = MegatronSDLoader(paths, version=2.0)
+        sd0 = loader.load(mp_world_size=2, mp_rank=0)
+        sd1 = loader.load(mp_world_size=2, mp_rank=1)
+        assert sd0["attention.query_key_value.weight"].shape == (12, 8)
+        assert sd0["mlp.dense_h_to_4h.weight"].shape == (16, 8)
+        # merge of the splits reproduces the original
+        loader_full = MegatronSDLoader(paths, version=2.0)
+        full = loader_full.load(1, 0)
+        merged = loader.merge_state_dicts([sd0, sd1])
+        np.testing.assert_allclose(
+            merged["attention.query_key_value.weight"],
+            full["attention.query_key_value.weight"])
+
+    def test_factory_json(self, tmp_path):
+        from deepspeed_tpu.runtime.state_dict_factory import SDLoaderFactory
+
+        paths = self._make_shards(tmp_path)
+        loader = SDLoaderFactory.get_sd_loader_json(
+            {"type": "Megatron", "checkpoints": paths, "version": 2.0})
+        assert loader.ckpt_mp_size == 2
+
+
+def test_env_report_runs():
+    from deepspeed_tpu.env_report import debug_report, op_report
+
+    rows = dict(debug_report())
+    assert "jax" in rows
+    ops = dict(op_report())
+    assert "ds_cpu_adam" in ops
