@@ -200,12 +200,25 @@ def run_autotuning(args, active_resources) -> None:
     the user script per experiment with the candidate config injected via
     ``DS_AUTOTUNING_CONFIG``, reading back the metric file the engine
     writes (metric_path)."""
+    # the ds config comes from --deepspeed_config (explicit, like the
+    # reference); only if absent fall back to the first json in user_args
     base_config = {}
-    for arg in list(getattr(args, "user_args", [])):
-        if arg.endswith(".json") and os.path.isfile(arg):
-            with open(arg) as f:
-                base_config = json.load(f)
+    user_args = list(getattr(args, "user_args", []))
+    cfg_arg = None
+    for i, arg in enumerate(user_args):
+        if arg in ("--deepspeed_config", "--deepspeed-config") and \
+                i + 1 < len(user_args):
+            cfg_arg = user_args[i + 1]
             break
+        if arg.startswith("--deepspeed_config="):
+            cfg_arg = arg.split("=", 1)[1]
+            break
+    if cfg_arg is None:
+        cfg_arg = next((a for a in user_args
+                        if a.endswith(".json") and os.path.isfile(a)), None)
+    if cfg_arg and os.path.isfile(cfg_arg):
+        with open(cfg_arg) as f:
+            base_config = json.load(f)
     at_cfg = AutotuningConfig(**base_config.get("autotuning", {}))
 
     results_dir = at_cfg.results_dir
@@ -244,8 +257,13 @@ def run_autotuning(args, active_resources) -> None:
         results.append({"name": exp["name"], "metric": metric,
                         "returncode": proc.returncode})
         if metric is not None and (best is None or metric > best["metric"]):
+            # best_config must NOT keep the injected experiment-mode
+            # autotuning block (it would re-activate profiling + a stale
+            # metric_path in production runs)
+            clean = copy.deepcopy(exp["ds_config"])
+            clean.pop("autotuning", None)
             best = {"name": exp["name"], "metric": metric,
-                    "ds_config": exp["ds_config"]}
+                    "ds_config": clean}
         logger.info(f"autotuning exp {exp['name']}: metric={metric}")
     with open(os.path.join(results_dir, "autotuning_results.json"),
               "w") as f:

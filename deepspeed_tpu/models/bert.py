@@ -1,0 +1,210 @@
+"""BERT-family encoder models.
+
+Covers the reference's encoder model families
+(``module_inject/containers/bert.py`` / ``distil_bert.py``, the
+``DeepSpeedTransformerLayer`` training kernel whose numerics are tested
+against the HF BERT layer in
+``tests/unit/ops/accelerators/test_accelerator_forward.py``, and the
+BERT-pretraining benchmark surface of
+``docs/_tutorials/bert-pretraining.md``). TPU-first: bidirectional flash
+attention (Pallas), bf16-friendly, scanned encoder stack with remat; MLM
+(+ optional NSP) pretraining loss follows the engine's
+``__call__(batch) -> loss`` convention.
+
+Family presets: ``bert`` (post-layernorm, learned positions, token types),
+``distil-bert`` (no token types, no pooler, half depth).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention.flash_attention import flash_attention
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    use_token_type: bool = True
+    use_pooler: bool = True
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+
+BERT_SIZES = {
+    "bert-base": dict(hidden_size=768, num_hidden_layers=12,
+                      num_attention_heads=12, intermediate_size=3072),
+    "bert-large": dict(hidden_size=1024, num_hidden_layers=24,
+                       num_attention_heads=16, intermediate_size=4096),
+    "distil-bert": dict(hidden_size=768, num_hidden_layers=6,
+                        num_attention_heads=12, intermediate_size=3072,
+                        use_token_type=False, use_pooler=False),
+}
+
+
+def bert_config(name: str = "bert-base", **overrides) -> BertConfig:
+    return BertConfig(**{**BERT_SIZES[name], **overrides})
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias, deterministic: bool):
+        cfg = self.config
+        h = cfg.num_attention_heads
+        d = cfg.hidden_size // h
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, T = x.shape[:2]
+
+        def heads(t):
+            return t.reshape(B, T, h, d)
+
+        needs_dropout = cfg.attention_probs_dropout_prob > 0 and \
+            not deterministic
+        if mask_bias is None and not needs_dropout:
+            out = flash_attention(heads(q), heads(k), heads(v), causal=False)
+        else:
+            if mask_bias is None:
+                # dropout needs materialized probs — bias-path with a zero
+                # mask so attention dropout is NOT silently skipped
+                mask_bias = jnp.zeros((1, 1, 1, 1), jnp.float32)
+            # padding masks need the bias path — plain jnp attention; XLA
+            # fuses it well for the short-seq encoder regime
+            scale = 1.0 / math.sqrt(d)
+            logits = jnp.einsum("bthd,bshd->bhts", heads(q), heads(k)) * scale
+            logits = logits + mask_bias
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            probs = probs.astype(x.dtype)
+            if cfg.attention_probs_dropout_prob > 0 and not deterministic:
+                probs = nn.Dropout(cfg.attention_probs_dropout_prob)(
+                    probs, deterministic=False)
+            out = jnp.einsum("bhts,bshd->bthd", probs, heads(v))
+        out = out.reshape(B, T, cfg.hidden_size)
+        return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias, deterministic: bool):
+        cfg = self.config
+        attn = BertSelfAttention(cfg, name="attention")(
+            x, mask_bias, deterministic)
+        if cfg.hidden_dropout_prob > 0 and not deterministic:
+            attn = nn.Dropout(cfg.hidden_dropout_prob)(
+                attn, deterministic=False)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="attention_ln")(x + attn)
+        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     name="intermediate")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(y)
+        if cfg.hidden_dropout_prob > 0 and not deterministic:
+            y = nn.Dropout(cfg.hidden_dropout_prob)(y, deterministic=False)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="output_ln")(x + y)
+
+
+class BertModel(nn.Module):
+    """Encoder: returns (sequence_output, pooled_output|None)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        B, T = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="word_embeddings")(input_ids)
+        pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                         dtype=cfg.dtype, name="position_embeddings")(pos)
+        if cfg.use_token_type:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             dtype=cfg.dtype,
+                             name="token_type_embeddings")(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="embeddings_ln")(x)
+        if cfg.hidden_dropout_prob > 0 and not deterministic:
+            x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=False)
+
+        mask_bias = None
+        if attention_mask is not None:
+            mask_bias = jnp.where(attention_mask[:, None, None, :] > 0,
+                                  0.0, -1e9).astype(jnp.float32)
+
+        layer = BertLayer
+        if cfg.remat:
+            layer = nn.remat(BertLayer, static_argnums=(3,))
+        for i in range(cfg.num_hidden_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, mask_bias, deterministic)
+
+        pooled = None
+        if cfg.use_pooler:
+            pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                                       name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+class BertForPreTraining(nn.Module):
+    """MLM (+ optional NSP) pretraining — ``__call__(batch) -> loss``.
+
+    batch keys: ``input_ids``, optional ``attention_mask``,
+    ``token_type_ids``, ``mlm_labels`` (-100 = unmasked), and optional
+    ``next_sentence_label`` when the pooler is on.
+    """
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic: bool = False):
+        cfg = self.config
+        seq_out, pooled = BertModel(cfg, name="bert")(
+            batch["input_ids"], batch.get("attention_mask"),
+            batch.get("token_type_ids"), deterministic=deterministic)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     name="mlm_transform")(seq_out)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlm_ln")(h)
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                          name="mlm_head")(h)
+
+        labels = batch["mlm_labels"]
+        mask = (labels >= 0).astype(jnp.float32)
+        safe_labels = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        token_ll = jnp.take_along_axis(logp, safe_labels[..., None],
+                                       axis=-1)[..., 0]
+        mlm_loss = -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+        loss = mlm_loss
+        if cfg.use_pooler and "next_sentence_label" in batch:
+            nsp_logits = nn.Dense(2, dtype=jnp.float32,
+                                  name="nsp_head")(pooled)
+            nsp_lp = jax.nn.log_softmax(nsp_logits, axis=-1)
+            nsp_loss = -jnp.mean(jnp.take_along_axis(
+                nsp_lp, batch["next_sentence_label"][:, None], axis=-1))
+            loss = loss + nsp_loss
+        return loss

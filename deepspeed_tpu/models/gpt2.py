@@ -40,6 +40,10 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     remat: bool = False  # activation checkpointing per block
+    # remat policy: "full" recomputes everything; "dots" saves matmul
+    # outputs and recomputes only elementwise ops (cheaper recompute,
+    # jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    remat_policy: str = "full"
     use_flash_attention: bool = False  # Pallas kernel (TPU only)
     # sequence/context parallelism over the `seq` mesh axis:
     # None | "ring" (ppermute KV rotation) | "ulysses" (all-to-all head swap)
@@ -190,7 +194,12 @@ class _ScanBody(nn.Module):
     def __call__(self, x, deterministic):
         block_cls = Block
         if self.config.remat:
-            block_cls = nn.remat(Block, prevent_cse=False, static_argnums=(2,))
+            policy = None
+            if self.config.remat_policy == "dots":
+                policy = jax.checkpoint_policies.\
+                    dots_with_no_batch_dims_saveable
+            block_cls = nn.remat(Block, prevent_cse=False,
+                                 static_argnums=(2,), policy=policy)
         x = block_cls(self.config, name="block")(x, deterministic)
         return x, None
 

@@ -765,14 +765,22 @@ class DeepSpeedEngine:
             self.compression_scheduler.step()
         at = self._config.autotuning
         if at.enabled and at.metric_path:
-            if self.global_steps == at.start_profile_step:
+            # global_steps here is already incremented (1, 2, ...); treat
+            # start_profile_step<=1 as "time from the first completed step"
+            start = max(at.start_profile_step, 1)
+            if self.global_steps == start or (
+                    self.global_steps > start and
+                    getattr(self, "_autotuning_t0", None) is None and
+                    not getattr(self, "_autotuning_written", False)):
                 jax.block_until_ready(metrics["loss"])
                 self._autotuning_t0 = time.perf_counter()
+                self._autotuning_start_step = self.global_steps
             elif self.global_steps >= at.end_profile_step and \
                     getattr(self, "_autotuning_t0", None) is not None:
                 jax.block_until_ready(metrics["loss"])
                 elapsed = time.perf_counter() - self._autotuning_t0
-                steps = self.global_steps - at.start_profile_step
+                steps = self.global_steps - self._autotuning_start_step
+                self._autotuning_written = True
                 import json as _json
 
                 with open(at.metric_path, "w") as f:

@@ -131,10 +131,13 @@ class MegatronSDLoader:
 
     def merge_query_key_value(self, values: List[np.ndarray],
                               dim: int = 0) -> np.ndarray:
-        """Interleave per-shard q/k/v thirds so the merged tensor is
-        [Q; K; V] over full heads (ckpt version >= 2 stores fused qkv
-        per shard as [q_shard; k_shard; v_shard])."""
-        if float(self.version) < 2.0:
+        """Megatron qkv layouts by checkpoint version (reference
+        state_dict_factory.py:220): version 0 stores [Q_shard; K_shard;
+        V_shard] fused per shard → merging must split each shard into
+        thirds and regroup so the result is [Q_all; K_all; V_all];
+        versions 1.0/2.0 store per-head-grouped layouts where a plain
+        concat over shards is already correct."""
+        if float(self.version) >= 1.0:
             return np.concatenate(values, axis=dim)
         qs, ks, vs = [], [], []
         for v in values:
@@ -148,7 +151,7 @@ class MegatronSDLoader:
 
     def split_query_key_value(self, value: np.ndarray, num_splits: int,
                               split_idx: int, dim: int = 0) -> np.ndarray:
-        if float(self.version) < 2.0:
+        if float(self.version) >= 1.0:
             return np.split(value, num_splits, axis=dim)[split_idx]
         q, k, v = np.split(value, 3, axis=dim)
         return np.concatenate(

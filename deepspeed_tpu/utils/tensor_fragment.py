@@ -50,12 +50,21 @@ def safe_get_full_fp32_param(engine, param_path: str) -> Optional[np.ndarray]:
         return None
     if engine._offload_opt is not None:
         # under offload the fp32 master lives host-side; the device params
-        # are the downcast compute copy — never return those as "fp32"
+        # are the downcast compute copy — never return those as "fp32".
+        # NVMe tier: buffers are swapped out (None) between steps — swap in
+        # for the read and back out.
+        off = engine._offload_opt
         key = param_path.replace(".", "/")
-        flat = engine._offload_opt.master.get(key)
-        if flat is not None:
-            shape = engine._offload_opt._shapes[key]
-            return np.asarray(flat, np.float32).reshape(shape)
+        if key in off.master:
+            swapped = off.nvme and off.master.get(key) is None
+            if swapped:
+                off._swap_in_all()
+            flat = off.master.get(key)
+            out = None if flat is None else \
+                np.asarray(flat, np.float32).reshape(off._shapes[key]).copy()
+            if swapped:
+                off._swap_out_all()
+            return out
     source = engine.state.get("master") or engine.state["params"]
     leaf = _lookup(source, param_path)
     return None if leaf is None else \
@@ -82,15 +91,22 @@ def safe_get_full_optimizer_state(engine, param_path: str,
     import jax
 
     if engine._offload_opt is not None:
-        store = {"exp_avg": engine._offload_opt.m,
-                 "exp_avg_sq": engine._offload_opt.v}.get(optim_state_key)
+        off = engine._offload_opt
+        store = {"exp_avg": off.m, "exp_avg_sq": off.v}.get(optim_state_key)
         if store is None:
             return None
-        flat = store.get(param_path.replace(".", "/"))
-        if flat is None:
+        key = param_path.replace(".", "/")
+        if key not in store:
             return None
-        shape = engine._offload_opt._shapes[param_path.replace(".", "/")]
-        return np.asarray(flat, np.float32).reshape(shape)
+        swapped = off.nvme and store.get(key) is None
+        if swapped:
+            off._swap_in_all()
+        flat = store.get(key)
+        out = None if flat is None else \
+            np.asarray(flat, np.float32).reshape(off._shapes[key]).copy()
+        if swapped:
+            off._swap_out_all()
+        return out
     if engine.state is None or engine.state.get("opt_state") is None:
         return None
     opt = engine.state["opt_state"]
@@ -128,9 +144,17 @@ def safe_set_full_fp32_param(engine, param_path: str, value) -> bool:
                 host_params, engine._shardings["params"])
             ok = True
     if engine._offload_opt is not None:
+        off = engine._offload_opt
         key = param_path.replace(".", "/")
-        if key in engine._offload_opt.master:
-            engine._offload_opt.master[key] = np.ascontiguousarray(
+        if key in off.master:
+            swapped = off.nvme and off.master.get(key) is None
+            if swapped:
+                off._swap_in_all()
+            off.master[key] = np.ascontiguousarray(
                 np.asarray(value, np.float32))
+            if swapped:
+                # persist the write to the NVMe tier — otherwise the next
+                # swap-in restores the stale file copy
+                off._swap_out_all()
             ok = True
     return ok
