@@ -14,6 +14,7 @@ __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
 from . import parallel  # noqa: F401
+from .runtime import zero  # noqa: F401  (deepspeed.zero namespace parity)
 from .runtime.activation_checkpointing import checkpointing  # noqa: F401
 from .accelerator import get_accelerator  # noqa: F401
 from .runtime.config import DeepSpeedConfig  # noqa: F401

@@ -210,7 +210,8 @@ def run_autotuning(args, active_resources) -> None:
                 i + 1 < len(user_args):
             cfg_arg = user_args[i + 1]
             break
-        if arg.startswith("--deepspeed_config="):
+        if arg.startswith("--deepspeed_config=") or \
+                arg.startswith("--deepspeed-config="):
             cfg_arg = arg.split("=", 1)[1]
             break
     if cfg_arg is None:

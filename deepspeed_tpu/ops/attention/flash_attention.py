@@ -32,6 +32,11 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# TPU vector layout: fp32 tiles are (8 sublanes, 128 lanes). Row statistics
+# (lse, delta) are carried replicated across a size-8 sublane dim so their
+# blocks satisfy the (8, 128) tiling rule; stats scratch is lane-width.
+SUBLANES = 8
+LANES = 128
 
 
 def _interpret() -> bool:
@@ -71,21 +76,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        m_prev = m_ref[:]
-        l_prev = l_ref[:]
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(j == num_kv - 1)
     def _finish():
-        l = jnp.maximum(l_ref[:], 1e-30)
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(l))[:, 0]
+        lse_row = (m_ref[:, :1] + jnp.log(l))[:, 0]  # (block_q,)
+        lse_ref[0] = jnp.broadcast_to(lse_row[None, :], lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int):
@@ -111,17 +118,17 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: in
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, SUBLANES, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, SUBLANES, seq_q), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -153,8 +160,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0, 0][:, None]  # stats replicated over sublane dim
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -197,8 +204,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -228,6 +235,8 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal: bool, scale: float, block_q: in
     block_k = min(block_k, seq_k)
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # sublane-replicated stats layout (see SUBLANES note at the top)
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, SUBLANES, seq_q))
 
     grid_q = (bh, seq_q // block_q, seq_k // block_k)
     dq = pl.pallas_call(
@@ -242,9 +251,9 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal: bool, scale: float, block_q: in
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, SUBLANES, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, SUBLANES, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
@@ -267,9 +276,9 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal: bool, scale: float, block_q: in
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+            pl.BlockSpec((1, SUBLANES, block_q), lambda b, j, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+            pl.BlockSpec((1, SUBLANES, block_q), lambda b, j, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[

@@ -1,0 +1,128 @@
+"""ZeRO user-facing namespace.
+
+API parity with ``deepspeed.zero`` — ``Init`` (reference
+partition_parameters.py:616) and ``GatheredParameters`` (:1545). The
+reference needs both because its params are mutable torch objects that get
+physically scattered: ``Init`` hijacks module construction to shard at
+birth; ``GatheredParameters`` re-materializes shards for user surgery.
+
+Under GSPMD, params are whole *logical* arrays whose placement the engine's
+sharding policy owns, so:
+
+* :class:`Init` is a construction context that (a) records the intended
+  dtype/device for abstract ("meta") init of models too big to materialize
+  unsharded — delegating to ``utils/init_on_device.OnDevice`` — and (b)
+  accepts and ignores the reference's process-group/config knobs (sharding
+  comes from the engine policy, not construction).
+* :class:`GatheredParameters` yields HOST copies of the requested params
+  (always "gathered" in the logical sense) and, when ``modifier_rank`` is
+  set, writes modifications back into the engine's sharded state on exit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+from .config import DeepSpeedZeroConfig, ZeroStageEnum
+from .mics import MiCS_Init
+from .policy import ShardingRules, ZeroShardingPolicy
+from .tiling import TiledLinear
+
+
+class Init:
+    def __init__(self, module=None, data_parallel_group=None,
+                 mem_efficient_linear: bool = True, remote_device=None,
+                 pin_memory: bool = False, config_dict_or_path=None,
+                 config=None, enabled: bool = True, dtype=None, mpu=None):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.remote_device = remote_device
+        self._ctx = None
+
+    def __enter__(self):
+        if self.enabled:
+            from ...utils.init_on_device import OnDevice
+
+            self._ctx = OnDevice(dtype=self.dtype, device="meta")
+            self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)
+            self._ctx = None
+        return False
+
+    def abstract_init(self, module, *args, **kwargs):
+        """Shapes-only init for checkpoint-restore targets (the zero.Init
+        use case: construct without materializing)."""
+        from ...utils.init_on_device import OnDevice
+
+        ctx = self._ctx or OnDevice(dtype=self.dtype, device="meta")
+        return ctx.abstract_init(module, *args, **kwargs)
+
+
+class GatheredParameters:
+    """with zero.GatheredParameters(engine, modifier_rank=0) as params:
+        params["block"]["kernel"][:] = ...   # host numpy, mutable
+
+    On exit (when ``modifier_rank`` is not None) the modified tree is
+    re-uploaded against the engine's shardings, and the offload master is
+    re-synced so the next step keeps the edit.
+    """
+
+    def __init__(self, engine_or_params, modifier_rank: Optional[int] = 0,
+                 fwd_module=None, enabled: bool = True):
+        self.enabled = enabled
+        self.modifier_rank = modifier_rank
+        self._engine = None
+        self._params = None
+        if hasattr(engine_or_params, "state"):
+            self._engine = engine_or_params
+        else:
+            self._params = engine_or_params
+            if self.modifier_rank is not None and enabled:
+                # a raw tree cannot receive write-backs (jax arrays are
+                # immutable; the engine holds the authoritative state) —
+                # failing loudly beats silently dropping the user's edits
+                raise ValueError(
+                    "GatheredParameters over a raw params tree is "
+                    "read-only: pass modifier_rank=None, or pass the "
+                    "engine to persist modifications")
+
+    def __enter__(self):
+        import jax
+
+        if not self.enabled:
+            return None
+        source = self._engine.state["params"] if self._engine is not None \
+            else self._params
+        self._host = jax.device_get(source)
+        return self._host
+
+    def __exit__(self, *exc):
+        import jax
+
+        if not self.enabled or self.modifier_rank is None or \
+                self._engine is None or exc[0] is not None:
+            return False
+        self._engine.state["params"] = jax.device_put(
+            self._host, self._engine._shardings["params"])
+        if self._engine.state.get("master") is not None:
+            import jax.numpy as jnp
+
+            master = jax.tree_util.tree_map(
+                lambda h, m: jnp.asarray(h, jnp.float32)
+                if jnp.issubdtype(jnp.asarray(m).dtype, jnp.floating)
+                else jnp.asarray(h),
+                self._host, jax.device_get(self._engine.state["master"]))
+            self._engine.state["master"] = jax.device_put(
+                master, self._engine._shardings["master"])
+        if self._engine._offload_opt is not None:
+            self._engine._offload_opt.sync_master_from(self._host)
+        return False
+
+
+__all__ = ["Init", "GatheredParameters", "MiCS_Init", "TiledLinear",
+           "DeepSpeedZeroConfig", "ZeroStageEnum", "ZeroShardingPolicy",
+           "ShardingRules"]

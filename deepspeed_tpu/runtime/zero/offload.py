@@ -131,6 +131,39 @@ class OffloadedOptimizer:
                                   self._leaf_file(p, "master"))
         self._aio.wait()
 
+    def read_leaf(self, kind: str, key: str) -> Optional[np.ndarray]:
+        """Fetch ONE leaf (kind: master|m|v) regardless of swap state —
+        O(leaf) NVMe I/O, not a whole-model swap (used by the
+        safe_get_full_* debug APIs)."""
+        store = {"master": self.master, "m": self.m, "v": self.v}[kind]
+        if key not in store:
+            return None
+        if store[key] is not None:
+            arr = np.asarray(store[key], np.float32)
+        else:
+            shape = self._shapes[key]
+            n = int(np.prod(shape)) if shape else 1
+            arr = np.empty(n, np.float32)
+            self._aio.async_pread(arr, self._leaf_file(key, kind))
+            self._aio.wait()
+        return arr.reshape(self._shapes[key]).copy()
+
+    def write_leaf(self, kind: str, key: str, value: np.ndarray) -> bool:
+        """Overwrite ONE leaf, persisting to the NVMe tier when swapped."""
+        store = {"master": self.master, "m": self.m, "v": self.v}[kind]
+        if key not in store:
+            return False
+        flat = np.ascontiguousarray(np.asarray(value, np.float32))
+        if store[key] is not None:
+            # in-memory layout: master keeps the param shape, moments are
+            # raveled 1-D buffers (see __init__)
+            store[key] = flat.reshape(self._shapes[key]) \
+                if kind == "master" else flat.ravel()
+        else:
+            self._aio.async_pwrite(flat.ravel(), self._leaf_file(key, kind))
+            self._aio.wait()
+        return True
+
     # --- step -----------------------------------------------------------
     def step(self, grads_host, lr: float, step_num: int, compute_dtype):
         """Apply one host Adam step. ``grads_host``: pytree of fp32 numpy

@@ -51,20 +51,10 @@ def safe_get_full_fp32_param(engine, param_path: str) -> Optional[np.ndarray]:
     if engine._offload_opt is not None:
         # under offload the fp32 master lives host-side; the device params
         # are the downcast compute copy — never return those as "fp32".
-        # NVMe tier: buffers are swapped out (None) between steps — swap in
-        # for the read and back out.
-        off = engine._offload_opt
+        # read_leaf fetches O(leaf) from the NVMe tier when swapped out.
         key = param_path.replace(".", "/")
-        if key in off.master:
-            swapped = off.nvme and off.master.get(key) is None
-            if swapped:
-                off._swap_in_all()
-            flat = off.master.get(key)
-            out = None if flat is None else \
-                np.asarray(flat, np.float32).reshape(off._shapes[key]).copy()
-            if swapped:
-                off._swap_out_all()
-            return out
+        if key in engine._offload_opt.master:
+            return engine._offload_opt.read_leaf("master", key)
     source = engine.state.get("master") or engine.state["params"]
     leaf = _lookup(source, param_path)
     return None if leaf is None else \
@@ -91,22 +81,11 @@ def safe_get_full_optimizer_state(engine, param_path: str,
     import jax
 
     if engine._offload_opt is not None:
-        off = engine._offload_opt
-        store = {"exp_avg": off.m, "exp_avg_sq": off.v}.get(optim_state_key)
-        if store is None:
+        kind = {"exp_avg": "m", "exp_avg_sq": "v"}.get(optim_state_key)
+        if kind is None:
             return None
-        key = param_path.replace(".", "/")
-        if key not in store:
-            return None
-        swapped = off.nvme and store.get(key) is None
-        if swapped:
-            off._swap_in_all()
-        flat = store.get(key)
-        out = None if flat is None else \
-            np.asarray(flat, np.float32).reshape(off._shapes[key]).copy()
-        if swapped:
-            off._swap_out_all()
-        return out
+        return engine._offload_opt.read_leaf(
+            kind, param_path.replace(".", "/"))
     if engine.state is None or engine.state.get("opt_state") is None:
         return None
     opt = engine.state["opt_state"]
@@ -144,17 +123,7 @@ def safe_set_full_fp32_param(engine, param_path: str, value) -> bool:
                 host_params, engine._shardings["params"])
             ok = True
     if engine._offload_opt is not None:
-        off = engine._offload_opt
-        key = param_path.replace(".", "/")
-        if key in off.master:
-            swapped = off.nvme and off.master.get(key) is None
-            if swapped:
-                off._swap_in_all()
-            off.master[key] = np.ascontiguousarray(
-                np.asarray(value, np.float32))
-            if swapped:
-                # persist the write to the NVMe tier — otherwise the next
-                # swap-in restores the stale file copy
-                off._swap_out_all()
+        if engine._offload_opt.write_leaf(
+                "master", param_path.replace(".", "/"), value):
             ok = True
     return ok
