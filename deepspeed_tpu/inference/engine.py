@@ -74,6 +74,7 @@ class InferenceEngine:
         self._jit_logits = None
         self._jit_prefill = None
         self._jit_decode = None
+        self._jit_decode_scan = None
         self._jit_sample = None
         self._cache = None
         self._cache_batch = None
@@ -151,10 +152,32 @@ class InferenceEngine:
             sampled = jax.random.categorical(rng, scaled, axis=-1)
             return jnp.where(greedy, jnp.argmax(last, axis=-1), sampled)
 
+        def decode_scan_fn(params, cache, token, pos, rng, temperature,
+                           greedy, n_steps, top_k, top_p):
+            """The whole decode loop as ONE compiled program — the TPU
+            equivalent of the reference's CUDA-graph capture/replay
+            (inference/engine.py:532,551): a single dispatch generates
+            ``n_steps`` tokens, so per-step host/dispatch latency vanishes."""
+
+            def body(carry, _):
+                cache, token, pos, rng = carry
+                logits, cache = decode_fn(params, cache, token[:, None], pos)
+                rng, sub = jax.random.split(rng)
+                nxt = sample_fn(logits, sub, temperature, top_k, top_p,
+                                greedy).astype(jnp.int32)
+                return (cache, nxt, pos + 1, rng), nxt
+
+            (cache, token, pos, rng), toks = jax.lax.scan(
+                body, (cache, token, pos, rng), None, length=n_steps)
+            return cache, toks.T  # (B, n_steps)
+
         self._jit_logits = jax.jit(logits_fn)
         self._jit_prefill = jax.jit(prefill_fn)
         self._jit_decode = jax.jit(decode_fn, donate_argnums=(1,))
         self._jit_sample = jax.jit(sample_fn, static_argnums=(3, 4))
+        self._jit_decode_scan = jax.jit(decode_scan_fn,
+                                        donate_argnums=(1,),
+                                        static_argnums=(7, 8, 9))
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, *args, **kwargs):
@@ -184,6 +207,8 @@ class InferenceEngine:
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
         B, T = input_ids.shape
+        if max_new_tokens <= 0:
+            return np.asarray(input_ids)
         max_len = getattr(self.module.config, "max_seq_len", None)
         if max_len is not None and T + max_new_tokens > max_len:
             raise ValueError(
@@ -201,28 +226,47 @@ class InferenceEngine:
         rng, sub = jax.random.split(rng)
         token = self._jit_sample(logits, sub, jnp.asarray(temperature, jnp.float32),
                                  int(top_k), float(top_p), greedy)
-        # device-side token list: without eos no host sync happens inside the
-        # loop, so decode steps enqueue back-to-back (async dispatch)
-        dev_out = [token]
-        finished = np.zeros((B,), bool)
-        if eos_token_id is not None:
-            finished |= np.asarray(token) == eos_token_id
 
-        pos = T
-        for _ in range(max_new_tokens - 1):
-            if eos_token_id is not None and finished.all():
-                break
-            logits, cache = self._jit_decode(
-                self.params, cache, token[:, None], jnp.asarray(pos, jnp.int32))
-            rng, sub = jax.random.split(rng)
-            token = self._jit_sample(
-                logits, sub, jnp.asarray(temperature, jnp.float32),
-                int(top_k), float(top_p), greedy)
-            dev_out.append(token)
-            if eos_token_id is not None:
+        if eos_token_id is None:
+            # whole-loop compile (CUDA-graph analog): ONE dispatch for the
+            # entire decode — per-token host/tunnel latency disappears.
+            # n_steps is static, so bucket it (next power of two, capped by
+            # the KV capacity) to bound recompiles across varying budgets;
+            # the extra steps' outputs are sliced off.
+            n_steps = max_new_tokens - 1
+            bucket = 1
+            while bucket < n_steps:
+                bucket *= 2
+            if max_len is not None:
+                bucket = min(bucket, max_len - T - 1)
+            bucket = max(bucket, n_steps)
+            _, rest = self._jit_decode_scan(
+                self.params, cache, token.astype(jnp.int32),
+                jnp.asarray(T, jnp.int32), rng,
+                jnp.asarray(temperature, jnp.float32), greedy,
+                bucket, int(top_k), float(top_p))
+            toks = np.concatenate([np.asarray(token)[:, None],
+                                   np.asarray(rest)[:, :n_steps]], axis=1)
+        else:
+            # eager loop: checks eos on host each step for early exit
+            dev_out = [token]
+            finished = np.asarray(token) == eos_token_id
+
+            pos = T
+            for _ in range(max_new_tokens - 1):
+                if finished.all():
+                    break
+                logits, cache = self._jit_decode(
+                    self.params, cache, token[:, None],
+                    jnp.asarray(pos, jnp.int32))
+                rng, sub = jax.random.split(rng)
+                token = self._jit_sample(
+                    logits, sub, jnp.asarray(temperature, jnp.float32),
+                    int(top_k), float(top_p), greedy)
+                dev_out.append(token)
                 finished |= np.asarray(token) == eos_token_id
-            pos += 1
-        toks = np.stack([np.asarray(t) for t in dev_out], axis=1)
+                pos += 1
+            toks = np.stack([np.asarray(t) for t in dev_out], axis=1)
         if eos_token_id is not None:
             # clamp everything after each row's first eos to eos
             hit = np.cumsum(toks == eos_token_id, axis=1) > 0
