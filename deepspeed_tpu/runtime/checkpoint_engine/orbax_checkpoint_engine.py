@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import pickle
 from typing import Any, Optional
 
 from ...utils.logging import log_dist
@@ -44,8 +45,10 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         import jax
 
         if jax.process_index() == 0:
-            with open(path + ".meta.json", "w") as f:
-                json.dump(meta, f, default=str)
+            # pickle, not JSON: meta may carry client_state with numpy /
+            # arbitrary python values that must round-trip exactly
+            with open(path + ".meta.pkl", "wb") as f:
+                pickle.dump(meta, f)
 
     def load(self, path: str, map_location=None,
              restore_target: Any = None) -> Any:
@@ -55,13 +58,27 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         path = os.path.abspath(path)
         kwargs = {}
         if restore_target is not None:
+            # tolerate save/load config mismatches in OPTIONAL top-level
+            # entries (fp16 scale, master, opt_state): restrict the target
+            # to what the checkpoint actually stores (from its metadata)
+            if isinstance(restore_target, dict):
+                try:
+                    stored = set(self._ckptr.metadata(path).keys())
+                    restore_target = {k: v for k, v in restore_target.items()
+                                      if k in stored}
+                except Exception:
+                    pass  # metadata unavailable → full-target restore
             kwargs["restore_args"] = \
                 self._ocp.checkpoint_utils.construct_restore_args(restore_target)
+            kwargs["item"] = restore_target
+            kwargs["partial_restore"] = True  # skip on-disk-only entries
         arrays = self._ckptr.restore(path, **kwargs)
         meta = {}
-        meta_path = path + ".meta.json"
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
+        if os.path.exists(path + ".meta.pkl"):
+            with open(path + ".meta.pkl", "rb") as f:
+                meta = pickle.load(f)
+        elif os.path.exists(path + ".meta.json"):  # older layout
+            with open(path + ".meta.json") as f:
                 meta = json.load(f)
         return {"arrays": arrays, "meta": meta}
 

@@ -891,13 +891,9 @@ class DeepSpeedEngine:
     def _state_dict(self) -> Dict:
         import flax.serialization as fser
 
-        if dist.get_world_size() > 1:
-            # TODO(multi-host): per-process shard files
-            # (zero_pp_rank_* naming is already in checkpoint_meta_path);
-            # device_get would raise on non-addressable shards.
-            raise NotImplementedError(
-                "multi-host checkpointing lands with the universal-checkpoint "
-                "work; single-host (any chip count) is supported")
+        assert dist.get_world_size() == 1, \
+            "_state_dict is the single-host path; multi-host saves go " \
+            "through the orbax engine (save_checkpoint dispatches)"
         host = jax.device_get(self.state)
         sd = {
             "module": fser.to_state_dict(host["params"]),
@@ -924,6 +920,40 @@ class DeepSpeedEngine:
         }
         return sd
 
+    def _orbax_split_state(self):
+        """(sharded array tree, json-able meta) for the orbax engine —
+        the multi-host save path (every process writes its addressable
+        shards; reference per-zero_pp_rank shard files, engine.py:2485)."""
+        import flax.serialization as fser
+
+        # containers flattened to plain dicts: orbax round-trips dicts, not
+        # NamedTuples (AdamState / LossScaleState) — leaves stay sharded
+        # jax arrays; from_state_dict re-nests on load
+        arrays = {
+            "params": self.state["params"],
+            "master": self.state["master"],
+            "opt_state": fser.to_state_dict(self.state["opt_state"])
+            if self.state["opt_state"] is not None else None,
+            "step": self.state["step"],
+            "opt_step": self.state["opt_step"],
+            "scale": fser.to_state_dict(self.state["scale"])
+            if self.state["scale"] is not None else None,
+            "rng": self.state["rng"],
+        }
+        arrays = {k: v for k, v in arrays.items() if v is not None}
+        meta = {
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "dp_world_size": self.dp_world_size,
+            "mp_world_size": self.mp_world_size,
+            "lr_scheduler": self.lr_scheduler.state_dict()
+            if self.lr_scheduler is not None and
+            hasattr(self.lr_scheduler, "state_dict") else None,
+        }
+        return arrays, meta
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None,
                         save_latest: bool = True) -> None:
@@ -931,14 +961,41 @@ class DeepSpeedEngine:
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self.checkpoint_engine.create(tag)
-        sd = self._state_dict()
-        if client_state:
-            sd["client_state"] = client_state
-        path = checkpoint_meta_path(save_dir, tag, "model",
-                                    mp_rank=0, dp_rank=dist.get_rank())
-        if dist.get_rank() == 0:
-            self.checkpoint_engine.save(sd, path)
-        self.checkpoint_engine.commit(tag)
+        if dist.get_world_size() > 1:
+            # multi-host: orbax writes each process's addressable shards in
+            # parallel (device_get of non-addressable shards would fail)
+            from .checkpoint_engine.orbax_checkpoint_engine import (
+                OrbaxCheckpointEngine,
+            )
+
+            if not isinstance(self.checkpoint_engine, OrbaxCheckpointEngine):
+                self._orbax_engine = getattr(self, "_orbax_engine", None) or \
+                    OrbaxCheckpointEngine()
+                engine = self._orbax_engine
+            else:
+                engine = self.checkpoint_engine
+            arrays, meta = self._orbax_split_state()
+            if client_state:
+                meta["client_state"] = client_state
+            path = os.path.join(save_dir, str(tag), "orbax_state")
+            engine.save({"arrays": arrays, "meta": meta}, path)
+            if self._offload_opt is not None:
+                # host-resident optimizer state: one file per process
+                # (reference per-zero_pp_rank optim files, engine.py:2485)
+                self.checkpoint_engine.save(
+                    {"offload_optimizer": self._offload_opt.state_dict()},
+                    os.path.join(save_dir, str(tag),
+                                 f"offload_pp_rank_{jax.process_index()}"))
+            engine.commit(tag)
+        else:
+            sd = self._state_dict()
+            if client_state:
+                sd["client_state"] = client_state
+            path = checkpoint_meta_path(save_dir, tag, "model",
+                                        mp_rank=0, dp_rank=dist.get_rank())
+            if dist.get_rank() == 0:
+                self.checkpoint_engine.save(sd, path)
+            self.checkpoint_engine.commit(tag)
         if save_latest and dist.get_rank() == 0:
             write_latest(save_dir, tag)
         dist.barrier(name="save_checkpoint")
@@ -1025,6 +1082,12 @@ class DeepSpeedEngine:
             return self.load_universal_checkpoint(load_dir, tag)
         if tag is None:
             tag = read_latest(load_dir)
+        orbax_path = os.path.join(load_dir, str(tag), "orbax_state")
+        if os.path.isdir(orbax_path):
+            return self._load_orbax_checkpoint(load_dir, tag,
+                                               load_optimizer_states,
+                                               load_lr_scheduler_states,
+                                               load_module_only)
         path = checkpoint_meta_path(load_dir, tag, "model", mp_rank=0, dp_rank=0)
         sd = self.checkpoint_engine.load(path)
         assert self.state is not None, \
@@ -1075,6 +1138,77 @@ class DeepSpeedEngine:
         self.state = new_state
         log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
         return load_dir, sd.get("client_state", {})
+
+    def _load_orbax_checkpoint(self, load_dir: str, tag: str,
+                               load_optimizer_states: bool = True,
+                               load_lr_scheduler_states: bool = True,
+                               load_module_only: bool = False):
+        """Restore an orbax (multi-host/sharded) checkpoint directly into
+        the current shardings — each process reads its shards."""
+        from .checkpoint_engine.orbax_checkpoint_engine import (
+            OrbaxCheckpointEngine,
+        )
+
+        path = os.path.join(load_dir, str(tag), "orbax_state")
+        assert self.state is not None, \
+            "engine state not built yet — init params before load_checkpoint"
+        engine = getattr(self, "_orbax_engine", None) or \
+            OrbaxCheckpointEngine()
+        self._orbax_engine = engine
+        arrays, _ = self._orbax_split_state()
+        if load_module_only:
+            arrays = {"params": arrays["params"]}
+        target = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding), arrays)
+        blob = engine.load(path, restore_target=target)
+        restored, meta = blob["arrays"], blob["meta"]
+        import flax.serialization as fser
+
+        new_state = dict(self.state)
+        new_state["params"] = restored["params"]
+        if not load_module_only:
+            if load_optimizer_states:
+                if "master" in restored:
+                    new_state["master"] = restored["master"]
+                if "opt_state" in restored and \
+                        self.state["opt_state"] is not None:
+                    new_state["opt_state"] = fser.from_state_dict(
+                        self.state["opt_state"], restored["opt_state"])
+            for key in ("step", "opt_step", "rng"):
+                if key in restored:
+                    new_state[key] = restored[key]
+            if "scale" in restored and self.state["scale"] is not None:
+                new_state["scale"] = fser.from_state_dict(
+                    self.state["scale"], restored["scale"])
+            self.global_steps = meta.get("global_steps", 0)
+            self.global_samples = meta.get("global_samples", 0)
+            self.micro_steps = meta.get("micro_steps", 0)
+            self.skipped_steps = meta.get("skipped_steps", 0)
+            if load_lr_scheduler_states and self.lr_scheduler is not None \
+                    and meta.get("lr_scheduler") is not None and \
+                    hasattr(self.lr_scheduler, "load_state_dict"):
+                self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        self.state = new_state
+        if self._offload_opt is not None:
+            # restore this process's host optimizer state; without a file,
+            # re-seed the master from the loaded params so the next step
+            # doesn't clobber them (mirrors the single-host load guard)
+            off_path = os.path.join(load_dir, str(tag),
+                                    f"offload_pp_rank_{jax.process_index()}")
+            loaded_off = False
+            if load_optimizer_states and not load_module_only and \
+                    os.path.exists(off_path + ".meta"):
+                off_sd = self.checkpoint_engine.load(off_path)
+                if off_sd.get("offload_optimizer"):
+                    self._offload_opt.load_state_dict(
+                        off_sd["offload_optimizer"])
+                    loaded_off = True
+            if not loaded_off:
+                self._offload_opt.sync_master_from(
+                    jax.device_get(new_state["params"]))
+        log_dist(f"loaded orbax checkpoint {path}", ranks=[0])
+        return load_dir, meta.get("client_state", {})
 
     # ------------------------------------------------------------------
     def eval_batch_fn(self):

@@ -1,0 +1,104 @@
+"""Engine-level orbax (sharded/multi-host-path) checkpointing. In tests
+the world is one process, so the orbax path is exercised directly via
+the engine's split/restore helpers against sharded ZeRO-3 state."""
+
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+
+
+def _engine(lr=1e-2):
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from tests.unit.simple_model import SimpleModel
+
+    mesh_mod.reset_mesh()
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": lr}},
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                                    config=config)
+    return engine
+
+
+def test_orbax_roundtrip_sharded_state(tmp_path):
+    import jax
+
+    from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import (
+        OrbaxCheckpointEngine,
+    )
+    from tests.unit.simple_model import random_batch
+
+    engine = _engine()
+    b = random_batch(engine.train_batch_size())
+    for _ in range(3):
+        engine.train_batch(batch=b)
+
+    # save via the orbax split (the multi-host save path's payload)
+    arrays, meta = engine._orbax_split_state()
+    oe = OrbaxCheckpointEngine()
+    path = str(tmp_path / "ck" / "orbax_state")
+    oe.save({"arrays": arrays, "meta": meta}, path)
+    oe.commit("t")
+
+    l_ref = float(engine.train_batch(batch=b))
+
+    engine2 = _engine()
+    engine2.train_batch(batch=b)
+    loaded_dir, _ = engine2._load_orbax_checkpoint(str(tmp_path), "ck")
+    assert loaded_dir == str(tmp_path)
+    assert engine2.global_steps == 3
+    l2 = float(engine2.train_batch(batch=b))
+    assert np.isclose(l_ref, l2, rtol=1e-2), (l_ref, l2)
+    # restored arrays keep the ZeRO shardings (compute params here are under
+    # the stage-3 persistence threshold and stay replicated; the fp32
+    # master always shards)
+    m = engine2.state["master"]["linear_0"]["kernel"]
+    assert any(e is not None for e in m.sharding.spec), m.sharding
+
+
+def test_orbax_tolerates_optional_entry_mismatch(tmp_path):
+    """fp16 save (has loss-scale state) → bf16 load (no scale): optional
+    entries missing from the target must not break the restore."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import (
+        OrbaxCheckpointEngine,
+    )
+    from tests.unit.simple_model import SimpleModel, random_batch
+
+    mesh_mod.reset_mesh()
+    fp16_cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "fp16": {"enabled": True},
+        "steps_per_print": 1000,
+    }
+    eng, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                                 config=fp16_cfg)
+    b = random_batch(eng.train_batch_size())
+    for _ in range(2):
+        eng.train_batch(batch=b)
+    arrays, meta = eng._orbax_split_state()
+    assert "scale" in arrays
+    oe = OrbaxCheckpointEngine()
+    oe.save({"arrays": arrays, "meta": meta},
+            str(tmp_path / "m" / "orbax_state"))
+    oe.commit("m")
+
+    mesh_mod.reset_mesh()
+    bf16_cfg = dict(fp16_cfg)
+    bf16_cfg.pop("fp16")
+    bf16_cfg["bf16"] = {"enabled": True}
+    eng2, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                                  config=bf16_cfg)
+    eng2.train_batch(batch=b)
+    eng2._load_orbax_checkpoint(str(tmp_path), "m")  # no crash
+    assert eng2.global_steps == 2
