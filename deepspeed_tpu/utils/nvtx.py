@@ -1,0 +1,66 @@
+"""Profiler range annotation.
+
+Capability parity with reference ``deepspeed/utils/nvtx.py:9
+instrument_w_nvtx`` — wraps a function in a named profiler range. On TPU
+the range shows up in xprof/perfetto traces via
+``jax.profiler.TraceAnnotation`` and inside compiled programs via
+``jax.named_scope`` (which also names HLO ops for the flops profiler's
+per-module attribution).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def instrument_w_nvtx(func):
+    """Decorator: execute ``func`` inside a named trace range."""
+    import jax
+
+    name = getattr(func, "__qualname__", getattr(func, "__name__", "fn"))
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+def range_push(name: str) -> None:
+    """Eager range begin — reference signature (range_pop takes no args);
+    delegates to the accelerator's stack-managed implementation."""
+    from ..accelerator import get_accelerator
+
+    get_accelerator().range_push(name)
+
+
+def range_pop() -> None:
+    from ..accelerator import get_accelerator
+
+    get_accelerator().range_pop()
+
+
+class trace_range:
+    """with trace_range("phase"): ... — xprof-visible range that is ALSO a
+    jax.named_scope, so ops traced inside attribute to this name in the
+    flops profiler's per-module tree (same visibility as
+    ``instrument_w_nvtx``)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ctxs = None
+
+    def __enter__(self):
+        import jax
+
+        self._ctxs = (jax.profiler.TraceAnnotation(self.name),
+                      jax.named_scope(self.name))
+        for c in self._ctxs:
+            c.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        for c in reversed(self._ctxs):
+            c.__exit__(*exc)
+        return False
