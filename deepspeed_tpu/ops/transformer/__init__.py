@@ -1,0 +1,6 @@
+from .transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+__all__ = ["DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig"]

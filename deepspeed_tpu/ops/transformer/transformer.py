@@ -1,0 +1,110 @@
+"""DeepSpeedTransformerLayer — fused training transformer layer API.
+
+Capability parity with reference ``deepspeed/ops/transformer/transformer.py:296
+DeepSpeedTransformerLayer`` + ``DeepSpeedTransformerConfig`` (:22) — the
+BERT-style fused layer backed by ``csrc/transformer`` (qkv/attn/LN/GeLU/
+dropout fused fwd+bwd, tested against the HF BERT layer in
+``tests/unit/ops/accelerators/test_accelerator_forward.py``). On TPU the
+fusion is the compiler's job: the layer is expressed once in flax and XLA
+emits the fused kernels; Pallas flash attention handles the score/softmax
+tiling when masks permit. ``pre_layer_norm`` switches post-LN (BERT) vs
+pre-LN ordering, mirroring the reference flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...models.bert import BertConfig, BertLayer, BertSelfAttention
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Reference config surface (transformer.py:22). Unused CUDA-specific
+    knobs (stochastic_mode, gemm algos) are accepted and ignored."""
+
+    batch_size: int = -1
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    heads: int = 12
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    num_hidden_layers: int = -1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False   # memory trick: remat subsumes it
+    gelu_checkpoint: bool = False        # ditto
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    return_tuple: bool = False  # honored: forward returns (hidden,) when set
+    training: bool = True
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """Drop-in fused layer: ``__call__(hidden_states, attention_mask)``
+    with (B, T, H) activations, post-LN (BERT) or pre-LN ordering."""
+
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None,
+                 deterministic: Optional[bool] = None):
+        cfg = self.config
+        deterministic = (not cfg.training) if deterministic is None \
+            else deterministic
+        dtype = jnp.float16 if cfg.fp16 else jnp.float32
+        bert_cfg = BertConfig(
+            hidden_size=cfg.hidden_size,
+            num_attention_heads=cfg.heads,
+            intermediate_size=cfg.intermediate_size,
+            hidden_dropout_prob=cfg.hidden_dropout_ratio,
+            attention_probs_dropout_prob=cfg.attn_dropout_ratio,
+            layer_norm_eps=cfg.layer_norm_eps,
+            dtype=dtype,
+        )
+        mask_bias = None
+        if attention_mask is not None:
+            m = attention_mask
+            if m.ndim == 2:
+                m = m[:, None, None, :]
+            mask_bias = jnp.where(m > 0, 0.0, -1e9).astype(jnp.float32)
+
+        def result(out):
+            # reference return_tuple semantics (transformer.py:296 forward
+            # returns (hidden_states,) when set)
+            return (out,) if cfg.return_tuple else out
+
+        if not cfg.pre_layer_norm:
+            # post-LN (original BERT ordering) — exactly BertLayer
+            return result(BertLayer(bert_cfg, name="layer")(
+                hidden_states, mask_bias, deterministic))
+
+        # pre-LN ordering (reference pre_layer_norm=True)
+        x = hidden_states
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                           name="input_ln")
+        attn = BertSelfAttention(bert_cfg, name="attention")(
+            ln1(x), mask_bias, deterministic)
+        if cfg.hidden_dropout_ratio > 0 and not deterministic:
+            attn = nn.Dropout(cfg.hidden_dropout_ratio)(
+                attn, deterministic=False)
+        x = x + attn
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                           name="output_ln")
+        y = nn.Dense(cfg.intermediate_size, dtype=dtype,
+                     name="intermediate")(ln2(x))
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden_size, dtype=dtype, name="output")(y)
+        if cfg.hidden_dropout_ratio > 0 and not deterministic:
+            y = nn.Dropout(cfg.hidden_dropout_ratio)(y, deterministic=False)
+        return result(x + y)

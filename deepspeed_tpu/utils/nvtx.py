@@ -10,6 +10,7 @@ per-module attribution).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 
@@ -41,26 +42,13 @@ def range_pop() -> None:
     get_accelerator().range_pop()
 
 
-class trace_range:
+@contextlib.contextmanager
+def trace_range(name: str):
     """with trace_range("phase"): ... — xprof-visible range that is ALSO a
     jax.named_scope, so ops traced inside attribute to this name in the
     flops profiler's per-module tree (same visibility as
     ``instrument_w_nvtx``)."""
+    import jax
 
-    def __init__(self, name: str):
-        self.name = name
-        self._ctxs = None
-
-    def __enter__(self):
-        import jax
-
-        self._ctxs = (jax.profiler.TraceAnnotation(self.name),
-                      jax.named_scope(self.name))
-        for c in self._ctxs:
-            c.__enter__()
-        return self
-
-    def __exit__(self, *exc):
-        for c in reversed(self._ctxs):
-            c.__exit__(*exc)
-        return False
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
