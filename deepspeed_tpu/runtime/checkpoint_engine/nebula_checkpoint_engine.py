@@ -1,0 +1,17 @@
+"""Nebula-style async tiered checkpoint engine.
+
+The reference's ``NebulaCheckpointEngine``
+(runtime/checkpoint_engine/nebula_checkpoint_engine.py:20) provides async,
+tiered persistence via Azure Nebula. The TPU-native engine with those
+properties is the orbax engine (async background write, per-process
+sharded tiers, commit barrier) — exported here under the reference's name
+and selected by the ``nebula.enabled`` config block (the reference's
+selection path, engine._configure_checkpointing).
+"""
+
+from .orbax_checkpoint_engine import OrbaxCheckpointEngine
+
+
+class NebulaCheckpointEngine(OrbaxCheckpointEngine):
+    def __init__(self, config_params=None):
+        super().__init__(config_params, use_async=True)

@@ -154,6 +154,17 @@ class MeshDims(DeepSpeedConfigModel):
     seq: int = 1
 
 
+class NebulaConfig(DeepSpeedConfigModel):
+    """``nebula`` block (reference deepspeed/nebula/config.py) — selects
+    the async tiered checkpoint engine."""
+
+    enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: int = 100
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"  # Ignore | Warn | Fail
     load_universal: bool = False
@@ -232,6 +243,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     mesh: MeshDims = Field(default_factory=MeshDims)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    nebula: NebulaConfig = Field(default_factory=NebulaConfig)
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     aio: AioConfig = Field(default_factory=AioConfig)
     curriculum_learning: CurriculumParams = Field(default_factory=CurriculumParams)

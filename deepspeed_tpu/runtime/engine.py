@@ -179,7 +179,20 @@ class DeepSpeedEngine:
         cl = self._config.comms_logger
         dist.configure(enabled=cl.enabled, prof_all=cl.prof_all, prof_ops=cl.prof_ops,
                        verbose=cl.verbose, debug=cl.debug)
-        self.checkpoint_engine = ArrayCheckpointEngine()
+        # engine selection ≅ reference _configure_checkpointing: the
+        # nebula block picks the async tiered (orbax-backed) engine
+        if self._config.nebula.enabled:
+            from .checkpoint_engine.nebula_checkpoint_engine import (
+                NebulaCheckpointEngine,
+            )
+
+            self.checkpoint_engine = NebulaCheckpointEngine()
+            # array engine still backs the single-host npz format + the
+            # per-process offload files
+            self._array_ckpt_engine = ArrayCheckpointEngine()
+        else:
+            self.checkpoint_engine = ArrayCheckpointEngine()
+            self._array_ckpt_engine = self.checkpoint_engine
 
         # compression training (reference compression/scheduler.py hooks;
         # here the transform runs inside the compiled step)
@@ -961,19 +974,21 @@ class DeepSpeedEngine:
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self.checkpoint_engine.create(tag)
-        if dist.get_world_size() > 1:
-            # multi-host: orbax writes each process's addressable shards in
-            # parallel (device_get of non-addressable shards would fail)
-            from .checkpoint_engine.orbax_checkpoint_engine import (
-                OrbaxCheckpointEngine,
-            )
+        from .checkpoint_engine.orbax_checkpoint_engine import (
+            OrbaxCheckpointEngine,
+        )
 
-            if not isinstance(self.checkpoint_engine, OrbaxCheckpointEngine):
+        use_orbax = dist.get_world_size() > 1 or \
+            isinstance(self.checkpoint_engine, OrbaxCheckpointEngine)
+        if use_orbax:
+            # orbax writes each process's addressable shards in parallel
+            # (multi-host requirement; also the nebula/async engine path)
+            if isinstance(self.checkpoint_engine, OrbaxCheckpointEngine):
+                engine = self.checkpoint_engine
+            else:
                 self._orbax_engine = getattr(self, "_orbax_engine", None) or \
                     OrbaxCheckpointEngine()
                 engine = self._orbax_engine
-            else:
-                engine = self.checkpoint_engine
             arrays, meta = self._orbax_split_state()
             if client_state:
                 meta["client_state"] = client_state
@@ -982,7 +997,7 @@ class DeepSpeedEngine:
             if self._offload_opt is not None:
                 # host-resident optimizer state: one file per process
                 # (reference per-zero_pp_rank optim files, engine.py:2485)
-                self.checkpoint_engine.save(
+                self._array_ckpt_engine.save(
                     {"offload_optimizer": self._offload_opt.state_dict()},
                     os.path.join(save_dir, str(tag),
                                  f"offload_pp_rank_{jax.process_index()}"))
@@ -1199,7 +1214,7 @@ class DeepSpeedEngine:
             loaded_off = False
             if load_optimizer_states and not load_module_only and \
                     os.path.exists(off_path + ".meta"):
-                off_sd = self.checkpoint_engine.load(off_path)
+                off_sd = self._array_ckpt_engine.load(off_path)
                 if off_sd.get("offload_optimizer"):
                     self._offload_opt.load_state_dict(
                         off_sd["offload_optimizer"])

@@ -102,3 +102,43 @@ def test_orbax_tolerates_optional_entry_mismatch(tmp_path):
     eng2.train_batch(batch=b)
     eng2._load_orbax_checkpoint(str(tmp_path), "m")  # no crash
     assert eng2.global_steps == 2
+
+
+def test_nebula_config_selects_async_engine(tmp_path):
+    """nebula.enabled routes save_checkpoint through the async orbax
+    engine end to end (reference NebulaCheckpointEngine selection)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.runtime.checkpoint_engine.nebula_checkpoint_engine import (
+        NebulaCheckpointEngine,
+    )
+    from tests.unit.simple_model import SimpleModel, random_batch
+
+    mesh_mod.reset_mesh()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+        "nebula": {"enabled": True},
+        "steps_per_print": 1000,
+    }
+    eng, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                                 config=cfg)
+    assert isinstance(eng.checkpoint_engine, NebulaCheckpointEngine)
+    b = random_batch(eng.train_batch_size())
+    for _ in range(2):
+        eng.train_batch(batch=b)
+    eng.save_checkpoint(str(tmp_path))
+    l1 = float(eng.train_batch(batch=b))
+
+    mesh_mod.reset_mesh()
+    eng2, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                                  config=dict(cfg))
+    eng2.train_batch(batch=b)
+    eng2.load_checkpoint(str(tmp_path))
+    assert eng2.global_steps == 2
+    l2 = float(eng2.train_batch(batch=b))
+    import numpy as np
+
+    assert np.isclose(l1, l2, rtol=1e-3), (l1, l2)

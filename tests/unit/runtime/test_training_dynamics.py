@@ -152,3 +152,26 @@ class TestSparseTensor:
         c = a.add(b)
         dense = np.asarray(c.to_dense())
         assert dense[0, 0] == 1.0 and dense[2, 0] == 2.0
+
+
+class TestWeightQuantization:
+    def test_roundtrip_error_bounded(self):
+        from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+
+        rng = np.random.default_rng(0)
+        sd = {"mlp.weight": rng.standard_normal((32, 32)).astype(np.float32),
+              "ln.weight": np.ones(32, np.float32)}
+        wq = WeightQuantization(quantize_groups=4, mlp_extra_grouping=True)
+        qsd, scales = wq.quantize_state_dict(sd)
+        assert qsd["mlp.weight"].dtype == np.int8
+        assert "ln.weight" not in scales  # 1-D untouched
+        deq = WeightQuantization.dequantize_state_dict(qsd, scales)
+        err = np.abs(deq["mlp.weight"] - sd["mlp.weight"]).max()
+        assert err < np.abs(sd["mlp.weight"]).max() / 100, err
+
+    def test_int8_shrinks_storage(self):
+        from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+
+        sd = {"w": np.ones((64, 64), np.float32)}
+        qsd, scales = WeightQuantization().quantize_state_dict(sd)
+        assert qsd["w"].nbytes * 4 == sd["w"].nbytes
