@@ -1,0 +1,6 @@
+from .config import DeepSpeedInferenceConfig
+from .engine import InferenceEngine
+from .zero_inference import ZeroInferenceEngine
+
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine",
+           "ZeroInferenceEngine"]
