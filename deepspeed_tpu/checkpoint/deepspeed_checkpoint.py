@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import pickle
 import re
 from typing import Any, Dict, List, Optional
 
@@ -70,9 +71,28 @@ def _to_numpy(value):
 
 
 def _torch_load(path: str) -> Dict[str, Any]:
+    """Load a reference-format .pt checkpoint.
+
+    Prefers ``weights_only=True`` (no arbitrary-code unpickling) with the
+    Megatron ``args`` Namespace allowlisted; only on failure falls back to
+    full unpickling, which EXECUTES code embedded in the file — reference
+    checkpoints routinely carry custom classes, but only fall through for
+    files you trust.
+    """
+    import argparse
+
     import torch
 
-    return torch.load(path, map_location="cpu", weights_only=False)
+    try:
+        if hasattr(torch.serialization, "add_safe_globals"):
+            torch.serialization.add_safe_globals([argparse.Namespace])
+        return torch.load(path, map_location="cpu", weights_only=True)
+    except pickle.UnpicklingError as e:
+        logger.warning(
+            "%s failed the weights_only safe load (%s); falling back to full "
+            "unpickling, which EXECUTES code embedded in the checkpoint. Only "
+            "proceed with checkpoints from a trusted source.", path, e)
+        return torch.load(path, map_location="cpu", weights_only=False)
 
 
 def get_layer_cat_dim(key: str) -> Optional[int]:

@@ -227,6 +227,20 @@ class InferenceEngine:
         token = self._jit_sample(logits, sub, jnp.asarray(temperature, jnp.float32),
                                  int(top_k), float(top_p), greedy)
 
+        # The allocated KV capacity is the third-from-last dim of the cache
+        # k/v leaves — (B, capacity, KV, D), or (L, B, capacity, KV, D) when
+        # layers are nn.scan-stacked — authoritative even when the model
+        # config lacks max_seq_len. Steps past capacity would write out of
+        # bounds (silently clamped by JAX today, but fragile); fail loudly.
+        cache_cap = max((x.shape[-3] for x in jax.tree_util.tree_leaves(cache)
+                         if getattr(x, "ndim", 0) >= 4), default=None)
+        caps = [c for c in (max_len, cache_cap) if c is not None]
+        capacity = min(caps) if caps else None
+        if capacity is not None and T + max_new_tokens > capacity:
+            raise ValueError(
+                f"prompt({T}) + max_new_tokens({max_new_tokens}) exceeds the "
+                f"allocated KV-cache capacity({capacity})")
+
         if eos_token_id is None:
             # whole-loop compile (CUDA-graph analog): ONE dispatch for the
             # entire decode — per-token host/tunnel latency disappears.
@@ -237,8 +251,8 @@ class InferenceEngine:
             bucket = 1
             while bucket < n_steps:
                 bucket *= 2
-            if max_len is not None:
-                bucket = min(bucket, max_len - T - 1)
+            if capacity is not None:
+                bucket = min(bucket, capacity - T - 1)
             bucket = max(bucket, n_steps)
             _, rest = self._jit_decode_scan(
                 self.params, cache, token.astype(jnp.int32),

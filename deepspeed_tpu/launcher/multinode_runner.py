@@ -19,6 +19,23 @@ from shlex import quote
 from typing import Dict, List
 
 
+def _uniform_slot_counts(resource_pool: Dict[str, List[int]],
+                         backend: str) -> "tuple[int, int]":
+    """(total processes, processes per node) from a host→slot-id-list pool.
+
+    MPI-family runners address ranks as ``-n total -ppn per_node`` and so
+    require every node to expose the same slot count; raise otherwise.
+    """
+    per_node = [len(slots) for slots in resource_pool.values()]
+    if not per_node:
+        raise ValueError(f"{backend} launch requires a non-empty resource pool")
+    if any(n != per_node[0] for n in per_node):
+        raise ValueError(
+            f"{backend} requires the same number of devices per node, "
+            f"got {dict(zip(resource_pool, per_node))}")
+    return sum(per_node), per_node[0]
+
+
 class MultiNodeRunner(ABC):
     def __init__(self, args, world_info_base64: str):
         self.args = args
@@ -51,28 +68,31 @@ class PDSHRunner(MultiNodeRunner):
     def backend_exists(self) -> bool:
         return shutil.which("pdsh") is not None
 
-    def get_cmd(self, environment, active_resources):
-        environment["PDSH_RCMD_TYPE"] = "ssh"
-        active_workers = ",".join(active_resources.keys())
-        pdsh_cmd = ["pdsh", "-S", "-f", "1024", "-w", active_workers]
-        exports = ""
-        for key, val in self.exports.items():
-            exports += f"export {key}={quote(val)}; "
-        # launch one node-local launcher per host; rank derived from %n
-        deepspeed_launch = [
-            exports, f"cd {os.path.abspath('.')};", sys.executable, "-u", "-m",
-            "deepspeed_tpu.launcher.launch",
+    def _launcher_argv(self) -> List[str]:
+        """Argv of the node-local launcher module; %n is pdsh's node-rank token."""
+        argv = [
+            sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
             f"--world_info={self.world_info_base64}",
             "--node_rank=%n",
             f"--master_addr={self.args.master_addr}",
             f"--master_port={self.args.master_port}",
         ]
         if getattr(self.args, "elastic_training", False):
-            deepspeed_launch.append("--enable_elastic_training")
-            deepspeed_launch.append(f"--max_elastic_restarts="
-                                    f"{self.args.max_elastic_restarts}")
-        return pdsh_cmd + [" ".join(deepspeed_launch + [self.user_script] +
-                                    list(map(quote, self.user_arguments)))]
+            argv += ["--enable_elastic_training",
+                     f"--max_elastic_restarts={self.args.max_elastic_restarts}"]
+        return argv + [self.user_script] + [quote(a) for a in self.user_arguments]
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        # The remote side gets ONE shell string: env exports, then cd into the
+        # same working directory the user launched from, then the node-local
+        # launcher. pdsh fans it out to every active host (-S propagates the
+        # worst exit code back; -f caps ssh fanout).
+        remote = [f"export {k}={quote(v)};" for k, v in self.exports.items()]
+        remote.append(f"cd {os.path.abspath('.')};")
+        remote.extend(self._launcher_argv())
+        hosts = ",".join(active_resources.keys())
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts, " ".join(remote)]
 
 
 class OpenMPIRunner(MultiNodeRunner):
@@ -110,11 +130,8 @@ class MPICHRunner(MultiNodeRunner):
         return shutil.which("mpirun") is not None
 
     def get_cmd(self, environment, active_resources):
-        devices_per_node = self.resource_pool.values()
-        total_process_count = sum(devices_per_node)
-        process_per_node = list(devices_per_node)[0]
-        if not all(n == process_per_node for n in devices_per_node):
-            raise ValueError("MPICH requires same number of devices per node")
+        total_process_count, process_per_node = _uniform_slot_counts(
+            self.resource_pool, "MPICH")
         mpirun_cmd = [
             "mpirun", "-n", f"{total_process_count}", "-ppn",
             f"{process_per_node}",
@@ -136,11 +153,8 @@ class IMPIRunner(MultiNodeRunner):
         return shutil.which("mpirun") is not None
 
     def get_cmd(self, environment, active_resources):
-        devices_per_node = self.resource_pool.values()
-        total_process_count = sum(devices_per_node)
-        process_per_node = list(devices_per_node)[0]
-        if not all(n == process_per_node for n in devices_per_node):
-            raise ValueError("Intel MPI requires same number of devices per node")
+        total_process_count, process_per_node = _uniform_slot_counts(
+            self.resource_pool, "Intel MPI")
         export_cmd = []
         for k, v in self.exports.items():
             export_cmd += ["-genv", f"{k}", f"{v}"]
@@ -210,11 +224,8 @@ class MVAPICHRunner(MultiNodeRunner):
             return False
 
     def get_cmd(self, environment, active_resources):
-        devices_per_node = self.resource_pool.values()
-        total_process_count = sum(devices_per_node)
-        process_per_node = list(devices_per_node)[0]
-        if not all(n == process_per_node for n in devices_per_node):
-            raise ValueError("mvapich requires same number of devices per node")
+        total_process_count, process_per_node = _uniform_slot_counts(
+            self.resource_pool, "MVAPICH")
         with open(".mvapich_hostfile", "w") as f:
             for host in self.resource_pool.keys():
                 f.write(f"{host}\n")

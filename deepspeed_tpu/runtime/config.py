@@ -193,6 +193,9 @@ class CurriculumParams(DeepSpeedConfigModel):
     max_difficulty: int = 1024
     schedule_type: str = "fixed_linear"
     schedule_config: Dict[str, Any] = Field(default_factory=dict)
+    # batch key whose dim 2 (after gas-stacking) is the sequence axis; used to
+    # anchor seqlen truncation instead of guessing by size
+    seqlen_key: str = "input_ids"
 
 
 class EigenvalueConfig(DeepSpeedConfigModel):

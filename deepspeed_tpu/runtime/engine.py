@@ -708,11 +708,19 @@ class DeepSpeedEngine:
             return stacked
         seqlen = self.curriculum_scheduler.update_difficulty(
             self.global_steps + 1)
-        # the full sequence length = the largest trailing-dim size among
-        # (gas, batch, seq, ...) leaves; truncate EVERY axis of that size so
-        # attention masks (gas, b, seq, seq) stay consistent with input_ids
-        full = max((x.shape[2] for x in jax.tree_util.tree_leaves(stacked)
-                    if np.ndim(x) >= 3), default=0)
+        # Anchor the full sequence length to the token-id leaf (dim 2 of the
+        # gas-stacked (gas, batch, seq) array, key configurable) rather than
+        # guessing by size — a feature axis that coincidentally matches the
+        # seqlen must not be truncated. Axes equal to the anchored length are
+        # still truncated on every leaf so attention masks (gas, b, seq, seq)
+        # stay consistent with input_ids.
+        key = self._config.curriculum_learning.seqlen_key
+        if isinstance(stacked, dict) and key in stacked \
+                and np.ndim(stacked[key]) >= 3:
+            full = stacked[key].shape[2]
+        else:
+            full = max((x.shape[2] for x in jax.tree_util.tree_leaves(stacked)
+                        if np.ndim(x) >= 3), default=0)
         if full <= seqlen:
             return stacked
 

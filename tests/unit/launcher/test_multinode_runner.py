@@ -42,27 +42,34 @@ def test_openmpi_runner(runner_args, world_info):
 
 
 def test_mpich_runner(runner_args, world_info):
-    runner = mnrunner.MPICHRunner(runner_args, world_info, {"w1": 2, "w2": 2})
+    # resource pool values are slot-id LISTS — the shape runner.main() passes
+    runner = mnrunner.MPICHRunner(runner_args, world_info,
+                                  {"w1": [0, 1], "w2": [0, 1]})
     cmd = runner.get_cmd({}, {})
     assert cmd[0] == "mpirun"
     assert "-ppn" in cmd
+    assert cmd[cmd.index("-n") + 1] == "4"
+    assert cmd[cmd.index("-ppn") + 1] == "2"
     assert "test_script.py" in cmd
 
 
 def test_mpich_runner_mismatched_slots(runner_args, world_info):
-    runner = mnrunner.MPICHRunner(runner_args, world_info, {"w1": 2, "w2": 1})
+    runner = mnrunner.MPICHRunner(runner_args, world_info,
+                                  {"w1": [0, 1], "w2": [0]})
     with pytest.raises(ValueError):
         runner.get_cmd({}, {})
 
 
 def test_impi_runner(runner_args, world_info):
-    runner = mnrunner.IMPIRunner(runner_args, world_info, {"w1": 2, "w2": 2})
+    runner = mnrunner.IMPIRunner(runner_args, world_info,
+                                 {"w1": [0, 1], "w2": [0, 1]})
     cmd = runner.get_cmd({}, {})
     assert cmd[0] == "mpirun"
     joined = " ".join(cmd)
     assert "MASTER_ADDR" in joined
     assert "10.0.0.1" in joined
-    assert "WORLD_SIZE" in joined
+    assert "WORLD_SIZE 4" in joined
+    assert "LOCAL_SIZE 2" in joined
 
 
 def test_slurm_runner(runner_args, world_info):
