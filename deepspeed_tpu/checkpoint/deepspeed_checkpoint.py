@@ -87,6 +87,9 @@ def _torch_load(path: str) -> Dict[str, Any]:
         if hasattr(torch.serialization, "add_safe_globals"):
             torch.serialization.add_safe_globals([argparse.Namespace])
         return torch.load(path, map_location="cpu", weights_only=True)
+    except TypeError:
+        # torch < 1.13: no weights_only kwarg — plain load, as before
+        return torch.load(path, map_location="cpu")
     except pickle.UnpicklingError as e:
         logger.warning(
             "%s failed the weights_only safe load (%s); falling back to full "

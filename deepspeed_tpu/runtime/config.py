@@ -10,7 +10,7 @@ extensions under the ``"mesh"`` key (tp/pp/ep/sp degrees).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Dict, List, Literal, Optional, Union
 
 from pydantic import Field
 
@@ -118,6 +118,10 @@ class PipelineConfig(DeepSpeedConfigModel):
     activation_checkpoint_interval: int = 0
     pipe_partitioned: bool = True
     grad_partitioned: bool = True
+    # executed schedule: "1f1b" = TrainSchedule-interleaved executor with the
+    # constant-in-M activation ring (reference schedule.py:189); "gpipe" =
+    # forward roll + autodiff transpose (activations linear in micro count)
+    schedule: Literal["1f1b", "gpipe"] = "1f1b"
 
 
 class AutotuningBlock(DeepSpeedConfigModel):

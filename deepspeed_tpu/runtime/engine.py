@@ -116,6 +116,7 @@ class DeepSpeedEngine:
 
         # --- model --------------------------------------------------------
         self.module = model
+        self._user_loss_fn = loss_fn is not None
         self._loss_fn = self._resolve_loss_fn(model, loss_fn)
         self._params_host = model_parameters  # may be None until first batch
         self._rng_seed = self._config.seed

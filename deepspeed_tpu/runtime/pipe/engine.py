@@ -2,10 +2,16 @@
 
 Capability parity with reference ``deepspeed/runtime/pipe/engine.py:42
 PipelineEngine``: ``train_batch``/``eval_batch`` over micro-batch schedules,
-DP gradient reduction, tied-weight grads, ZeRO-composition rules. The
-executed schedule is the compiled SPMD GPipe loop in
-``PipelineModule.__call__`` (see module.py docstring) — instruction streams
-from ``schedule.py`` are its specification.
+DP gradient reduction, tied-weight grads, ZeRO-composition rules. Two
+executors, selected by ``pipeline.schedule``:
+
+* ``"1f1b"`` (default) — ``one_f_one_b.make_1f1b_grads`` executes the
+  ``TrainSchedule`` instruction stream (reference engine.py:1293
+  ``_exec_schedule``) as a compiled tick loop with interleaved fwd/bwd and
+  a constant-in-M activation ring; conformance is asserted against the
+  schedule in ``tests/unit/runtime/pipe/test_one_f_one_b.py``.
+* ``"gpipe"`` — the compiled SPMD forward roll in
+  ``PipelineModule.__call__`` with the autodiff transpose as backward.
 
 Differences from the reference, by construction:
 * activation sends/recvs = collective-permutes emitted from ``jnp.roll`` on
@@ -74,6 +80,34 @@ class PipelineEngine(DeepSpeedEngine):
 
     # the pipelined loss consumes ALL micro-batches in one call
     def _make_grads_fn(self, micro_grads, constrain_grads, scale_value, gas):
+        schedule = self._config.pipeline.schedule
+        if schedule == "1f1b" and self._user_loss_fn:
+            # the 1F1B executor differentiates PipelineModule.loss_fn at the
+            # last stage; a user-supplied whole-model loss_fn only composes
+            # with the autodiff (gpipe) executor
+            logger.warning(
+                "pipeline.schedule=1f1b ignores a user-supplied loss_fn; "
+                "falling back to the gpipe executor (set PipelineModule."
+                "loss_fn to use 1f1b)")
+            schedule = "gpipe"
+        if schedule == "1f1b":
+            from .one_f_one_b import make_1f1b_grads
+
+            pipe_grads = make_1f1b_grads(self.module)
+
+            def grads_fn(state, stacked_batch):
+                params = state["params"]
+                scale = scale_value(state)
+                rng = jax.random.fold_in(state["rng"], state["step"])
+                loss, grads, denom = pipe_grads(params, stacked_batch, rng,
+                                                scale)
+                grads = constrain_grads(grads, params)
+                return loss, grads, denom
+
+            return grads_fn
+
+        assert schedule == "gpipe", \
+            f"unknown pipeline.schedule {schedule!r} (1f1b | gpipe)"
         loss_fn = self._loss_fn
 
         def grads_fn(state, stacked_batch):

@@ -75,6 +75,21 @@ class TiedLayerSpec(LayerSpec):
         self.forward_fn = forward_fn
 
 
+def block_passes_deterministic(typename: type) -> bool:
+    """True when the block's ``__call__`` takes a positional ``deterministic``
+    flag (self, x, deterministic) — shared by the GPipe and 1F1B executors so
+    both pass the flag identically."""
+    import inspect
+
+    try:
+        sig = inspect.signature(typename.__call__)
+        return len([p for p in sig.parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)]) >= 3
+    except (TypeError, ValueError):
+        return False
+
+
 def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
     """Split ``weights`` into ``num_parts`` contiguous chunks minimizing the
     max chunk weight (≅ reference ds_utils.partition_balanced used by
@@ -251,15 +266,7 @@ class PipelineModule(nn.Module):
         self._post_specs = tuple(post_specs)
 
         spec0 = block_specs[0]
-        import inspect
-
-        try:
-            sig = inspect.signature(spec0.typename.__call__)
-            pass_det = len([p for p in sig.parameters.values()
-                            if p.kind in (p.POSITIONAL_ONLY,
-                                          p.POSITIONAL_OR_KEYWORD)]) >= 3
-        except (TypeError, ValueError):
-            pass_det = False
+        pass_det = block_passes_deterministic(spec0.typename)
         # lifted scan over ticks: params broadcast across iterations
         self.ticks = nn.scan(
             _PipeTick,

@@ -5,13 +5,13 @@ Capability parity with reference ``deepspeed/runtime/pipe/schedule.py`` —
 (:189,197-257), ``DataParallelSchedule`` (:327) and the ``PipeInstruction``
 vocabulary.
 
-On TPU the *executed* schedule is a compiled SPMD loop (see ``engine.py``):
-every tick, all stages run one forward (and, in the autodiff transpose, one
-backward) and rotate activations over the ``pipe`` axis — a compiler-
-scheduled GPipe/1F1B hybrid. These instruction streams remain the
-*specification*: tests assert the SPMD loop's tick count and microbatch
-ordering against them, and an eager per-instruction executor can interpret
-them directly.
+On TPU the *executed* schedule is a compiled SPMD loop. The default
+executor (``one_f_one_b.py``) runs THIS ``TrainSchedule`` stream: per tick
+each stage performs the schedule's ForwardPass and BackwardPass micro ids,
+activations/cotangents move by collective-permute (Send/Recv instructions),
+and conformance of the executed order against these streams is asserted in
+``tests/unit/runtime/pipe/test_one_f_one_b.py``. The ``"gpipe"`` executor
+(module.py) uses them as its tick-count specification only.
 """
 
 from __future__ import annotations
