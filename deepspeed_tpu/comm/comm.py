@@ -314,3 +314,49 @@ def axis_index(group: Group = None):
 def log_summary(show_straggler: bool = False):
     """≅ reference comm/comm.py:408."""
     return comms_logger.log_all(print_log=True, show_straggler=show_straggler)
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank consistency assertions (debug plane) — SURVEY §5.2 analog of the
+# reference's ZeRO-3 safe-mode check that all ranks reduce the same params
+# (stage3.py:1080 assert_ints_same_as_other_ranks) and of the prefetch
+# coordinator's trace-divergence error. On TPU the compiled program cannot
+# diverge *within* a step, so what can drift across hosts is the program's
+# INPUTS: config, param-tree structure, batch shapes, step counters. These
+# helpers hash those and compare host-side.
+# ---------------------------------------------------------------------------
+def stable_hash(value) -> int:
+    """Deterministic 63-bit hash of a (nested) value via canonical repr."""
+    import zlib
+
+    def canon(v):
+        if isinstance(v, dict):
+            items = sorted(v.items(), key=lambda kv: str(kv[0]))
+            return "{" + ",".join(
+                f"{k}:{canon(val)}" for k, val in items) + "}"
+        if isinstance(v, (list, tuple)):
+            return "[" + ",".join(canon(x) for x in v) + "]"
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return f"arr({tuple(v.shape)},{v.dtype})"
+        return repr(v)
+
+    data = canon(value).encode()
+    return (zlib.crc32(data) << 31) | zlib.crc32(data[::-1])
+
+
+def assert_same_across_ranks(value, name: str = "value") -> None:
+    """Raise (on every rank, with the per-rank table) if ``value``'s stable
+    hash differs across processes. Single-process: no-op."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    h = np.int64(stable_hash(value) % (2 ** 62))
+    gathered = all_gather_host(h)
+    if not (gathered == gathered[0]).all():
+        table = ", ".join(f"rank{i}={int(v)}" for i, v in enumerate(gathered))
+        raise RuntimeError(
+            f"cross-rank consistency check failed for {name!r}: processes "
+            f"disagree ({table}). All hosts must feed the same global config/"
+            f"batch structure — this is the analog of the reference's "
+            f"assert_ints_same_as_other_ranks (stage3.py:1080).")

@@ -227,6 +227,10 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     dump_state: bool = False
+    # SURVEY §5.2 analog of ZeRO-3 safe-mode cross-rank assertions
+    # (stage3.py:1080): hash config/param-structure/batch-structure and
+    # compare across hosts at step boundaries
+    check_rank_consistency: bool = False
 
     prescale_gradients: bool = False
     gradient_predivide_factor: float = 1.0

@@ -683,6 +683,8 @@ class DeepSpeedEngine:
             first = jax.tree_util.tree_map(lambda x: x[0], stacked)
             self._build_state(self._init_params_from_batch(first))
 
+        if self._config.check_rank_consistency:
+            self._check_rank_consistency(stacked)
         self._maybe_profile_flops(stacked)
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
@@ -700,6 +702,23 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).stop()
         self._after_step(metrics)
         return loss
+
+    def _check_rank_consistency(self, stacked) -> None:
+        """Debug-mode cross-host assertions (SURVEY §5.2; reference
+        stage3.py:1080 assert_ints_same_as_other_ranks analog): in the SPMD
+        model the compiled program cannot diverge mid-step, so what CAN
+        drift across hosts is its inputs — batch structure, param-tree
+        structure, and the step counter. Hash each and compare host-side;
+        a mismatch raises on every rank with the per-rank hash table."""
+        from ..comm import comm as dist
+
+        dist.assert_same_across_ranks(
+            {"step": self.global_steps,
+             "gas": self.gradient_accumulation_steps()}, "step/gas counters")
+        dist.assert_same_across_ranks(stacked, "batch structure")
+        dist.assert_same_across_ranks(
+            jax.tree_util.tree_structure(self.state["params"]).__repr__(),
+            "param tree structure")
 
     def _apply_curriculum(self, stacked):
         """Truncate the sequence dim to the current curriculum difficulty

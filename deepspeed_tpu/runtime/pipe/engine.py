@@ -117,12 +117,14 @@ class PipelineEngine(DeepSpeedEngine):
 
             def scaled_loss(p):
                 loss = loss_fn(p, stacked_batch, rng)
-                return (loss * scale).astype(jnp.float32), loss
+                # seed with scale*gas so grads follow the engine-wide
+                # SUM-over-micros convention (denom = gas) — keeps the
+                # prescale_gradients branch identical across executors
+                return (loss * scale * gas).astype(jnp.float32), loss
 
             grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
             grads = constrain_grads(grads, params)
-            # loss is already the mean over micro-batches → denom 1
-            return loss, grads, 1.0
+            return loss, grads, float(gas)
 
         return grads_fn
 

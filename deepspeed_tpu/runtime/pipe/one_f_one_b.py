@@ -48,6 +48,21 @@ from ...parallel import mesh as mesh_mod
 from ...parallel.mesh import PIPE_AXIS
 
 
+def forward_micro_ids(t, stage_ids, num_stages):
+    """ForwardPass micro id per stage at tick ``t`` (invalid outside [0, M))."""
+    del num_stages
+    return t - stage_ids
+
+
+def backward_micro_ids(t, stage_ids, num_stages):
+    """BackwardPass micro id per stage at tick ``t``."""
+    return t - 2 * (num_stages - 1) + stage_ids
+
+
+def total_ticks(num_micro_batches, num_stages):
+    return num_micro_batches + 2 * (num_stages - 1)
+
+
 def _constrain_pipe(x, mb_dim: int = 1):
     """Pin dim 0 of a (S, ...) buffer to the pipe axis and the micro-batch
     dim to the batch axes, when a mesh is active."""
@@ -156,8 +171,8 @@ def make_1f1b_grads(module) -> Callable:
                     m, 0, M - 1)), s), n_local))(stage_ids, micro_ids)
 
         def tick(carry, t):
-            f_id = t - stage_ids                     # ForwardPass micro ids
-            b_id = t - 2 * (S - 1) + stage_ids       # BackwardPass micro ids
+            f_id = forward_micro_ids(t, stage_ids, S)
+            b_id = backward_micro_ids(t, stage_ids, S)
             valid_f = (f_id >= 0) & (f_id < M)
             valid_b = (b_id >= 0) & (b_id < M)
             keys_f = micro_keys(f_id)
@@ -231,8 +246,7 @@ def make_1f1b_grads(module) -> Callable:
                              d_pre=d_pre, d_post=d_post, loss_sum=loss_sum)
             return new_carry, None
 
-        total_ticks = M + 2 * (S - 1)
-        final, _ = jax.lax.scan(tick, carry0, jnp.arange(total_ticks))
+        final, _ = jax.lax.scan(tick, carry0, jnp.arange(total_ticks(M, S)))
 
         # assemble the full gradient tree: blocks + pre/post/tied subtrees
         # (tied keys get contributions from BOTH embed and head vjps)

@@ -87,18 +87,25 @@ def test_unknown_schedule_rejected():
 # ---------------------------------------------------------------------------
 
 def _executor_ticks(M, S):
-    """Per-stage per-tick ops [('F'|'B', micro), ...] the 1F1B executor
-    performs, mirroring one_f_one_b.py's index arithmetic."""
+    """Per-stage per-tick ops [('F'|'B', micro), ...] built from the SAME
+    index functions the executor's scan body consumes."""
+    from deepspeed_tpu.runtime.pipe.one_f_one_b import (
+        backward_micro_ids,
+        forward_micro_ids,
+        total_ticks,
+    )
+
+    stage_ids = np.arange(S)
     ticks = {s: [] for s in range(S)}
-    for t in range(M + 2 * (S - 1)):
+    for t in range(total_ticks(M, S)):
+        f_ids = forward_micro_ids(t, stage_ids, S)
+        b_ids = backward_micro_ids(t, stage_ids, S)
         for s in range(S):
             ops = []
-            f = t - s
-            if 0 <= f < M:
-                ops.append(("F", f))
-            b = t - 2 * (S - 1) + s
-            if 0 <= b < M:
-                ops.append(("B", b))
+            if 0 <= f_ids[s] < M:
+                ops.append(("F", int(f_ids[s])))
+            if 0 <= b_ids[s] < M:
+                ops.append(("B", int(b_ids[s])))
             ticks[s].append(ops)
     return ticks
 
