@@ -1,6 +1,11 @@
 from .basic_layer import (
+    BNCompress,
+    ColumnParallelLinearCompress,
+    ConvLayerCompress,
     EmbeddingCompress,
     LinearLayerCompress,
+    RowParallelLinearCompress,
+    compression_tp_rules,
     quantize_activation,
     quantize_weight,
 )
@@ -8,6 +13,7 @@ from .compress import (
     build_compression_transform,
     init_compression,
     redundancy_clean,
+    shrink_params,
     student_initialization,
 )
 from .config import CompressionConfig
@@ -15,7 +21,9 @@ from .scheduler import CompressionScheduler
 
 __all__ = [
     "CompressionConfig", "CompressionScheduler", "init_compression",
-    "redundancy_clean", "student_initialization",
+    "redundancy_clean", "shrink_params", "student_initialization",
     "build_compression_transform", "LinearLayerCompress",
-    "EmbeddingCompress", "quantize_weight", "quantize_activation",
+    "EmbeddingCompress", "ConvLayerCompress", "BNCompress",
+    "ColumnParallelLinearCompress", "RowParallelLinearCompress",
+    "compression_tp_rules", "quantize_weight", "quantize_activation",
 ]

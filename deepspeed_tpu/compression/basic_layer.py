@@ -145,3 +145,133 @@ class EmbeddingCompress(nn.Module):
         if self.weight_bits is not None:
             table = quantize_weight(table, self.weight_bits)
         return jnp.take(table, ids, axis=0)
+
+
+class ConvLayerCompress(nn.Module):
+    """Conv with weight/activation fake-quant and sparse/channel pruning on
+    the forward pass — reference Conv2dLayer_Compress (basic_layer.py:404).
+    Flax kernel layout (kh, kw, in, out): channel pruning masks the last
+    (output-channel) dim."""
+
+    features: int
+    kernel_size: tuple = (3, 3)
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+    act_bits: Optional[int] = None
+    act_q_type: str = "asymmetric"
+    weight_bits: Optional[int] = None
+    weight_q_groups: int = 1
+    sparse_dense_ratio: Optional[float] = None
+    channel_dense_ratio: Optional[float] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            self.kernel_size + (in_ch, self.features),
+                            self.dtype)
+        if self.weight_bits is not None:
+            kernel = quantize_weight(kernel, self.weight_bits,
+                                     self.weight_q_groups)
+        if self.sparse_dense_ratio is not None:
+            kernel = kernel * sparse_l1_mask(kernel, self.sparse_dense_ratio)
+        ch_mask = None
+        if self.channel_dense_ratio is not None:
+            ch_mask = channel_prune_mask(kernel, self.channel_dense_ratio)
+            kernel = kernel * ch_mask
+        if self.act_bits is not None:
+            x = quantize_activation(x, self.act_bits, self.act_q_type)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), self.dtype)
+            if ch_mask is not None:
+                bias = bias * ch_mask
+            y = y + bias
+        return y
+
+
+class BNCompress(nn.Module):
+    """BatchNorm whose scale/bias follow a channel-pruning mask — reference
+    BNLayer_Compress (basic_layer.py:611). Pass the producing conv's channel
+    mask so normalization of pruned channels is inert."""
+
+    use_running_average: bool = True
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, channel_mask: Optional[jnp.ndarray] = None):
+        y = nn.BatchNorm(use_running_average=self.use_running_average,
+                         momentum=self.momentum, epsilon=self.epsilon,
+                         dtype=self.dtype, name="bn")(x)
+        if channel_mask is not None:
+            y = y * channel_mask
+        return y
+
+
+class ColumnParallelLinearCompress(LinearLayerCompress):
+    """Column-parallel compressed linear — reference
+    ColumnParallelLinear_Compress (basic_layer.py:767). On TPU the TP split
+    is a sharding annotation: kernel (in, out) sharded (None, model); the
+    output stays sharded over ``model`` for a following row-parallel layer.
+    Compression math is inherited unchanged — masks/fake-quant are
+    elementwise and commute with GSPMD sharding."""
+
+    @nn.compact
+    def __call__(self, x):
+        y = super().__call__(x)
+        from ..parallel import mesh as mesh_mod
+
+        if mesh_mod.has_mesh():
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # leading dims UNCONSTRAINED so data-parallel batch sharding
+            # survives; only the feature dim is pinned to the model axis
+            U = PartitionSpec.UNCONSTRAINED
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh_mod.get_mesh(),
+                                 PartitionSpec(*([U] * (y.ndim - 1)
+                                                 + [mesh_mod.MODEL_AXIS]))))
+        return y
+
+
+class RowParallelLinearCompress(LinearLayerCompress):
+    """Row-parallel compressed linear — reference RowParallelLinear_Compress
+    (basic_layer.py:802): kernel (in, out) sharded (model, None); XLA inserts
+    the partial-sum reduction the reference does with an explicit
+    all-reduce."""
+
+    @nn.compact
+    def __call__(self, x):
+        y = super().__call__(x)
+        from ..parallel import mesh as mesh_mod
+
+        if mesh_mod.has_mesh():
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # feature dim replicated (the partial-sum reduction point);
+            # leading dims unconstrained to preserve batch sharding
+            U = PartitionSpec.UNCONSTRAINED
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh_mod.get_mesh(),
+                                 PartitionSpec(*([U] * (y.ndim - 1)
+                                                 + [None]))))
+        return y
+
+
+def compression_tp_rules():
+    """Sharding rules for the TP compressed linears (≅ the reference's
+    explicit column/row weight splits)."""
+    from ..parallel.mesh import MODEL_AXIS
+
+    return [
+        (r"col_parallel.*/kernel", (None, MODEL_AXIS)),
+        (r"col_parallel.*/bias", (MODEL_AXIS,)),
+        (r"row_parallel.*/kernel", (MODEL_AXIS, None)),
+    ]

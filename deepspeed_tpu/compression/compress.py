@@ -136,9 +136,79 @@ def init_compression(config: Dict[str, Any]) -> Tuple[CompressionConfig,
 def redundancy_clean(params: Any, config: CompressionConfig) -> Any:
     """Materialize the final pruning decisions (hard zeros) — reference
     redundancy_clean. Quantization groups also collapse to their target
-    bits."""
+    bits. For physical dim reduction see :func:`shrink_params`."""
     transform = build_compression_transform(config)
     return transform(params, jnp.asarray(10 ** 9))
+
+
+def shrink_params(params: Any, config: CompressionConfig,
+                  couplings: Optional[Dict[str, List[str]]] = None) -> Any:
+    """Physically remove row/head-pruned units — the reference's
+    ``fix_compression(..., dim_reduction=True)`` (helper.py:207) path.
+
+    Row pruning drops output features of the matched kernel (last dim) and
+    its bias; each path in ``couplings[matched_path]`` then has the SAME
+    kept-indices sliced from its input dim (dim 0) — the reference does this
+    mask hand-off between a pruned layer and its consumer inside
+    redundancy_clean. Head pruning shrinks the attention output projection's
+    input dim by whole heads.
+
+    Returns a new (host, numpy) param tree with smaller arrays; pair it with
+    a model built at the reduced width. Output parity with the masked big
+    model is asserted in tests/unit/compression/.
+    """
+    couplings = couplings or {}
+    # compute masks from (and emit) the CLEANED params so the kept-index
+    # sets agree exactly with redundancy_clean's masks — ranking rows on
+    # raw weights could diverge when quantization reorders near-threshold
+    # rows, breaking the shrink/mask parity guarantee
+    params = redundancy_clean(params, config)
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        flat[_leaf_path(path)] = np.asarray(leaf)
+
+    keep: Dict[str, np.ndarray] = {}      # path -> kept OUTPUT indices
+    keep_in: Dict[str, np.ndarray] = {}   # path -> kept INPUT indices
+
+    for g in config.technique_groups("row_pruning"):
+        ratio = float(g.params.get("dense_ratio", 0.5))
+        for key, w in flat.items():
+            if not key.endswith("kernel") or not g.matches(key):
+                continue
+            mask = np.asarray(row_prune_mask(jnp.asarray(w), ratio))
+            idx = np.nonzero(mask)[0]
+            keep[key] = idx
+            keep[key.rsplit(".", 1)[0] + ".bias"] = idx
+            for consumer in couplings.get(key, []):
+                keep_in[consumer] = idx
+
+    for g in config.technique_groups("head_pruning"):
+        ratio = float(g.params.get("dense_ratio", 0.5))
+        heads = int(g.params.get("num_heads", 1))
+        for key, w in flat.items():
+            if not key.endswith("kernel") or not g.matches(key):
+                continue
+            mask = np.asarray(head_prune_mask(jnp.asarray(w), ratio, heads))
+            idx = np.nonzero(mask)[0]
+            keep_in[key] = idx
+            for producer in couplings.get(key, []):
+                # the qkv/value projection feeding these heads loses the
+                # same units from its OUTPUT dim
+                keep[producer] = idx
+                keep[producer.rsplit(".", 1)[0] + ".bias"] = idx
+
+    def visit(path, leaf):
+        key = _leaf_path(path)
+        out = np.asarray(leaf)
+        if key in keep:
+            out = np.take(out, keep[key], axis=out.ndim - 1)
+        if key in keep_in and out.ndim >= 2:
+            # input-feature axis: dim 0 for (in, out) linears, dim ndim-2
+            # for conv (kh, kw, in, out) layouts
+            out = np.take(out, keep_in[key], axis=out.ndim - 2)
+        return out
+
+    return jax.tree_util.tree_map_with_path(visit, params)
 
 
 def student_initialization(student_params: Any, teacher_params: Any,

@@ -39,9 +39,11 @@ class CompressionGroup:
 
     def matches(self, param_path: str) -> bool:
         """Reference matching: module-name substring (modules=["*"] matches
-        everything)."""
+        everything). Separator-agnostic: flax scopes are written with "/" or
+        "." interchangeably."""
+        path = param_path.replace("/", ".")
         for pattern in self.modules:
-            if pattern == "*" or pattern in param_path:
+            if pattern == "*" or pattern.replace("/", ".") in path:
                 return True
         return False
 

@@ -198,3 +198,127 @@ def test_engine_compression_training():
     u = np.unique(np.round(k, 4))
     assert len(u) <= 33, len(u)  # 4-bit quantized grid (plus blend residue)
     assert engine.compression_scheduler.active_groups()
+
+
+# ---------------------------------------------------------------------------
+# round 2: conv/BN layers, TP compressed linears, physical dim reduction
+# ---------------------------------------------------------------------------
+def test_conv_layer_compress_forward_and_pruning():
+    from deepspeed_tpu.compression import ConvLayerCompress
+
+    conv = ConvLayerCompress(features=8, kernel_size=(3, 3), act_bits=8,
+                             weight_bits=8, sparse_dense_ratio=0.5,
+                             channel_dense_ratio=0.5)
+    x = jnp.asarray(np.random.default_rng(0)
+                    .standard_normal((2, 8, 8, 3)).astype(np.float32))
+    params = conv.init(jax.random.PRNGKey(0), x)
+    y = conv.apply(params, x)
+    assert y.shape == (2, 8, 8, 8)
+    # channel pruning zeroes half of the output channels entirely
+    dead = (np.asarray(y) == 0).all(axis=(0, 1, 2))
+    assert dead.sum() == 4, dead
+
+
+def test_bn_compress_masks_channels():
+    from deepspeed_tpu.compression import BNCompress
+
+    bn = BNCompress(use_running_average=False)
+    x = jnp.asarray(np.random.default_rng(1)
+                    .standard_normal((2, 4, 4, 6)).astype(np.float32))
+    mask = jnp.asarray([1.0, 0.0, 1.0, 0.0, 1.0, 1.0])
+    variables = bn.init(jax.random.PRNGKey(0), x, mask)
+    y, _ = bn.apply(variables, x, mask, mutable=["batch_stats"])
+    assert (np.asarray(y)[..., 1] == 0).all()
+    assert not (np.asarray(y)[..., 0] == 0).all()
+
+
+def test_tp_compressed_linears_on_mesh(eight_device_mesh):
+    from deepspeed_tpu.compression import (
+        ColumnParallelLinearCompress,
+        RowParallelLinearCompress,
+    )
+
+    class TpMlp(__import__("flax").linen.Module):
+        @__import__("flax").linen.compact
+        def __call__(self, x):
+            x = ColumnParallelLinearCompress(
+                features=16, weight_bits=8, name="col_parallel_fc")(x)
+            x = jax.nn.relu(x)
+            return RowParallelLinearCompress(
+                features=4, weight_bits=8, name="row_parallel_proj")(x)
+
+    mlp = TpMlp()
+    x = jnp.ones((2, 8))
+    params = mlp.init(jax.random.PRNGKey(0), x)
+    y = jax.jit(lambda p, v: mlp.apply(p, v))(params, x)
+    assert y.shape == (2, 4)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_compression_tp_rules_match_param_names():
+    import re
+
+    from deepspeed_tpu.compression import compression_tp_rules
+
+    rules = dict((pat, spec) for pat, spec in compression_tp_rules())
+    assert any(re.search(p, "col_parallel_fc/kernel") for p in rules)
+    assert any(re.search(p, "row_parallel_proj/kernel") for p in rules)
+
+
+def test_shrink_params_row_pruning_parity():
+    """Physical dim reduction (reference fix_compression dim_reduction=True):
+    the compacted small MLP reproduces the kept-unit computation exactly."""
+    from deepspeed_tpu.compression import CompressionConfig, shrink_params
+
+    rng = np.random.default_rng(0)
+    k1 = rng.standard_normal((8, 16)).astype(np.float32)
+    b1 = rng.standard_normal(16).astype(np.float32)
+    k2 = rng.standard_normal((16, 4)).astype(np.float32)
+    params = {"fc1": {"kernel": jnp.asarray(k1), "bias": jnp.asarray(b1)},
+              "fc2": {"kernel": jnp.asarray(k2)}}
+    cc = CompressionConfig({
+        "row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "rp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["fc1"]}}},
+    })
+    small = shrink_params(params, cc, couplings={"fc1.kernel": ["fc2.kernel"]})
+    assert np.asarray(small["fc1"]["kernel"]).shape == (8, 8)
+    assert np.asarray(small["fc1"]["bias"]).shape == (8,)
+    assert np.asarray(small["fc2"]["kernel"]).shape == (8, 4)
+
+    # kept indices = the 8 largest-L1 output columns of k1
+    scores = np.abs(k1).sum(axis=0)
+    kept = np.sort(np.argsort(scores)[-8:])
+    x = rng.standard_normal((3, 8)).astype(np.float32)
+    ref = np.maximum(x @ k1[:, kept] + b1[kept], 0) @ k2[kept]
+    got = np.maximum(
+        x @ np.asarray(small["fc1"]["kernel"]) + np.asarray(small["fc1"]["bias"]),
+        0) @ np.asarray(small["fc2"]["kernel"])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_shrink_params_head_pruning():
+    from deepspeed_tpu.compression import CompressionConfig, shrink_params
+
+    rng = np.random.default_rng(2)
+    # 4 heads x head_dim 4 = 16; output proj (16, 8); value proj (8, 16)
+    params = {"attn_out": {"kernel": jnp.asarray(
+        rng.standard_normal((16, 8)).astype(np.float32))},
+        "v_proj": {"kernel": jnp.asarray(
+            rng.standard_normal((8, 16)).astype(np.float32)),
+            "bias": jnp.asarray(rng.standard_normal(16).astype(np.float32))}}
+    cc = CompressionConfig({
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "hp1": {"params": {"dense_ratio": 0.5, "num_heads": 4},
+                        "modules": ["attn_out"]}}},
+    })
+    small = shrink_params(params, cc,
+                          couplings={"attn_out.kernel": ["v_proj.kernel"]})
+    # 2 of 4 heads kept → 8 input units on the out proj, 8 outputs on v_proj
+    assert np.asarray(small["attn_out"]["kernel"]).shape == (8, 8)
+    assert np.asarray(small["v_proj"]["kernel"]).shape == (8, 8)
+    assert np.asarray(small["v_proj"]["bias"]).shape == (8,)
