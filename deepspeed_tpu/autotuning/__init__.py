@@ -1,6 +1,8 @@
 from .autotuner import Autotuner, run_autotuning
 from .config import AutotuningConfig
+from .scheduler import Node, Reservation, ResourceManager
 from .tuner import CostModel, GridSearchTuner, ModelBasedTuner, RandomTuner
 
 __all__ = ["Autotuner", "run_autotuning", "AutotuningConfig",
-           "GridSearchTuner", "RandomTuner", "ModelBasedTuner", "CostModel"]
+           "GridSearchTuner", "RandomTuner", "ModelBasedTuner", "CostModel",
+           "ResourceManager", "Node", "Reservation"]

@@ -19,8 +19,6 @@ import copy
 import itertools
 import json
 import os
-import subprocess
-import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -196,10 +194,11 @@ class Autotuner:
 
 
 def run_autotuning(args, active_resources) -> None:
-    """Launcher ``--autotuning`` entry (reference runner.py:353): re-runs
-    the user script per experiment with the candidate config injected via
-    ``DS_AUTOTUNING_CONFIG``, reading back the metric file the engine
-    writes (metric_path)."""
+    """Launcher ``--autotuning`` entry (reference runner.py:353): schedules
+    every experiment as a REAL subprocess run of the user script through the
+    :class:`~deepspeed_tpu.autotuning.scheduler.ResourceManager` (candidate
+    config injected via ``DS_AUTOTUNING_CONFIG``; the engine profiles the
+    step window, writes metrics.json, and exits)."""
     # the ds config comes from --deepspeed_config (explicit, like the
     # reference); only if absent fall back to the first json in user_args
     base_config = {}
@@ -226,50 +225,40 @@ def run_autotuning(args, active_resources) -> None:
     os.makedirs(results_dir, exist_ok=True)
     tuner = Autotuner(base_config=base_config)
     exps = tuner._generate_experiments()
-    results = []
-    best = None
     for exp in exps:
-        exp_dir = os.path.join(at_cfg.exps_dir, exp["name"])
-        os.makedirs(exp_dir, exist_ok=True)
-        cfg_path = os.path.join(exp_dir, "ds_config.json")
-        metric_path = os.path.join(exp_dir, "metric.json")
-        exp["ds_config"].setdefault("autotuning", {})
-        exp["ds_config"]["autotuning"].update(
-            {"enabled": True, "metric_path": metric_path,
-             "start_profile_step": at_cfg.start_profile_step,
-             "end_profile_step": at_cfg.end_profile_step})
-        with open(cfg_path, "w") as f:
-            json.dump(exp["ds_config"], f)
         # DS_AUTOTUNING_EXIT makes the engine stop the run right after the
         # profile window — an experiment costs ~end_profile_step steps, not
         # a full training run
-        env = dict(os.environ, DS_AUTOTUNING_CONFIG=cfg_path,
-                   DS_AUTOTUNING_EXIT="1")
-        cmd = [sys.executable, "-u", args.user_script] + list(args.user_args)
-        proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
-        metric = None
-        if os.path.exists(metric_path):
-            with open(metric_path) as f:
-                m = json.load(f)
-            # higher-is-better normalization (latency flips sign, matching
-            # the in-process path)
-            metric = -m["latency"] if at_cfg.metric == "latency" \
-                else m.get("throughput")
-        results.append({"name": exp["name"], "metric": metric,
-                        "returncode": proc.returncode})
-        if metric is not None and (best is None or metric > best["metric"]):
-            # best_config must NOT keep the injected experiment-mode
-            # autotuning block (it would re-activate profiling + a stale
-            # metric_path in production runs)
-            clean = copy.deepcopy(exp["ds_config"])
-            clean.pop("autotuning", None)
-            best = {"name": exp["name"], "metric": metric,
-                    "ds_config": clean}
-        logger.info(f"autotuning exp {exp['name']}: metric={metric}")
+        exp["ds_config"].setdefault("autotuning", {})
+        exp["ds_config"]["autotuning"].update(
+            {"enabled": True,
+             "start_profile_step": at_cfg.start_profile_step,
+             "end_profile_step": at_cfg.end_profile_step})
+
+    from .scheduler import ResourceManager
+
+    hosts = {h: max(1, len(v) if isinstance(v, (list, tuple)) else int(v))
+             for h, v in (active_resources or {"localhost": 1}).items()}
+    manager = ResourceManager(
+        hosts=hosts, results_dir=results_dir, exps_dir=at_cfg.exps_dir,
+        arg_mappings=at_cfg.arg_mappings,
+        master_port=getattr(args, "master_port", 29500))
+    manager.schedule_experiments(exps)
+    finished = manager.run(args.user_script, list(args.user_args))
+
+    results = [{"name": e["name"],
+                "metric": (e.get("metrics") or {}).get(at_cfg.metric),
+                "returncode": e.get("returncode"),
+                "reservation": e.get("reservation")}
+               for e in finished.values()]
     with open(os.path.join(results_dir, "autotuning_results.json"),
               "w") as f:
         json.dump(results, f, indent=2)
+    best = manager.best(at_cfg.metric)
     if best:
+        # best_config must NOT keep the injected experiment-mode autotuning
+        # block (it would re-activate profiling in production runs) — the
+        # manager already strips it
         with open(os.path.join(results_dir, "best_config.json"), "w") as f:
             json.dump(best["ds_config"], f, indent=2)
     logger.info(f"autotuning done; best = {best['name'] if best else None}")

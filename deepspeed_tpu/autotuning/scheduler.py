@@ -1,0 +1,226 @@
+"""Experiment scheduler — the reference ``autotuning/scheduler.py:33
+ResourceManager`` analog.
+
+Schedules tuning experiments as REAL runs: each experiment is the user
+script launched in a subprocess with the candidate config injected via
+``DS_AUTOTUNING_CONFIG`` (the engine reads it, profiles the configured step
+window, writes ``metrics.json`` and exits under ``DS_AUTOTUNING_EXIT`` —
+runtime/engine.py _after_step). The manager holds a pool of (host, slot)
+reservations, runs as many experiments concurrently as there are idle slots
+(threads; one slot per experiment), skips experiments whose results
+already exist (resume), applies the reference's ``arg_mappings`` rewrite
+of user CLI args with tuned values, and collects metrics for the tuner.
+
+Differences from the reference, by design: slots are TPU hosts (one JAX
+process drives all local chips), not per-GPU ranks; remote hosts launch
+through the same multinode runners the launcher uses — on one host the
+subprocess path is exercised end-to-end in tests/unit/autotuning.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..runtime.config_utils import get_nested as _get_by_dotted_key
+from ..utils.logging import logger
+
+
+class Node:
+    def __init__(self, host: str, slots: int):
+        self.host = host
+        self.max_slots = slots
+        self.idle_slots: List[int] = list(range(slots))
+
+    def reserve(self, n: int) -> Optional[List[int]]:
+        if len(self.idle_slots) < n:
+            return None
+        taken, self.idle_slots = self.idle_slots[:n], self.idle_slots[n:]
+        return taken
+
+    def release(self, slots: Sequence[int]) -> None:
+        self.idle_slots.extend(slots)
+
+
+class Reservation:
+    def __init__(self, node: Node, slots: List[int]):
+        self.node = node
+        self.slots = slots
+
+    def release(self) -> None:
+        self.node.release(self.slots)
+
+    def __repr__(self):
+        return f"{self.node.host}:{','.join(map(str, self.slots))}"
+
+
+
+
+class ResourceManager:
+    """≅ reference autotuning/scheduler.py:33 — queue + reservations +
+    threaded experiment execution + result collection."""
+
+    def __init__(self, hosts: Dict[str, int], results_dir: str,
+                 exps_dir: str, arg_mappings: Optional[Dict[str, str]] = None,
+                 master_port: int = 29500,
+                 env: Optional[Dict[str, str]] = None):
+        self.nodes = [Node(h, n) for h, n in hosts.items()]
+        self.results_dir = results_dir
+        self.exps_dir = exps_dir
+        self.arg_mappings = dict(arg_mappings or {})
+        self.master_port = master_port
+        self.env = dict(env or {})
+        self.experiment_queue: List[Dict[str, Any]] = []
+        self.running: Dict[int, Tuple[threading.Thread, Dict, Reservation]] = {}
+        self.finished: Dict[int, Dict[str, Any]] = {}
+        self._count = 0
+        self._lock = threading.Lock()
+
+    # -- queueing ---------------------------------------------------------
+    def schedule_experiments(self, exps: Sequence[Dict[str, Any]]) -> None:
+        for exp in exps:
+            exp = dict(exp)
+            exp["exp_id"] = self._count
+            self._count += 1
+            result_dir = os.path.join(self.results_dir, exp["name"])
+            exp["result_dir"] = result_dir
+            metric_file = os.path.join(result_dir, "metrics.json")
+            exp.setdefault("ds_config", {}).setdefault("autotuning", {})
+            exp["ds_config"]["autotuning"]["metric_path"] = metric_file
+            # resume: a finished experiment (metrics present) is not re-run
+            if os.path.exists(metric_file):
+                logger.info(f"skipping exp {exp['name']}: result exists")
+                with open(metric_file) as f:
+                    exp["metrics"] = json.load(f)
+                exp["returncode"] = 0
+                self.finished[exp["exp_id"]] = exp
+                continue
+            self.experiment_queue.append(exp)
+
+    # -- reservations -----------------------------------------------------
+    def _reserve(self, n_slots: int = 1) -> Optional[Reservation]:
+        for node in self.nodes:
+            slots = node.reserve(n_slots)
+            if slots is not None:
+                return Reservation(node, slots)
+        return None
+
+    # -- execution --------------------------------------------------------
+    def _run_experiment(self, exp: Dict[str, Any], reservation: Reservation,
+                        user_script: str, user_args: List[str]) -> None:
+        try:
+            self._run_experiment_inner(exp, reservation, user_script,
+                                       user_args)
+        except Exception as e:  # a worker failure must still be recorded
+            logger.warning(f"exp {exp['name']} failed in scheduler: {e}")
+            exp.setdefault("returncode", -1)
+            exp["metrics"] = None
+            exp["error"] = str(e)
+            with self._lock:
+                self.finished[exp["exp_id"]] = exp
+
+    def _run_experiment_inner(self, exp: Dict[str, Any],
+                              reservation: Reservation, user_script: str,
+                              user_args: List[str]) -> None:
+        result_dir = exp["result_dir"]
+        exp["reservation"] = repr(reservation)
+        os.makedirs(result_dir, exist_ok=True)
+        exp_dir = os.path.join(self.exps_dir, exp["name"])
+        os.makedirs(exp_dir, exist_ok=True)
+        cfg_path = os.path.join(exp_dir, "ds_config.json")
+        with open(cfg_path, "w") as f:
+            json.dump(exp["ds_config"], f, indent=2)
+
+        # reference arg_mappings: rewrite user CLI args with tuned values
+        args = list(user_args)
+        for key, arg_name in self.arg_mappings.items():
+            val = _get_by_dotted_key(exp["ds_config"], key)
+            if val is None or str(val) == "auto":
+                continue
+            if arg_name in args and args.index(arg_name) + 1 < len(args):
+                args[args.index(arg_name) + 1] = str(val)
+            else:
+                if arg_name in args:  # dangling flag at the end
+                    args.remove(arg_name)
+                args += [arg_name, str(val)]
+
+        env = dict(os.environ)
+        env.update(self.env)
+        env.update({
+            "DS_AUTOTUNING_CONFIG": cfg_path,
+            "DS_AUTOTUNING_EXIT": "1",
+            "MASTER_PORT": str(self.master_port + exp["exp_id"]),
+        })
+        cmd = [sys.executable, "-u", user_script] + args
+        t0 = time.perf_counter()
+        with open(os.path.join(result_dir, "stdout.log"), "w") as out, \
+                open(os.path.join(result_dir, "stderr.log"), "w") as err:
+            proc = subprocess.run(cmd, env=env, stdout=out, stderr=err)
+        exp["returncode"] = proc.returncode
+        exp["wall_s"] = time.perf_counter() - t0
+        metric_file = exp["ds_config"]["autotuning"]["metric_path"]
+        if os.path.exists(metric_file):
+            with open(metric_file) as f:
+                exp["metrics"] = json.load(f)
+        else:
+            exp["metrics"] = None
+        with self._lock:
+            self.finished[exp["exp_id"]] = exp
+        logger.info(f"exp {exp['name']} rc={proc.returncode} "
+                    f"metrics={exp['metrics']}")
+
+    def run(self, user_script: str, user_args: List[str],
+            poll_s: float = 0.2) -> Dict[int, Dict[str, Any]]:
+        """Drain the queue, keeping every idle slot busy (the reference's
+        schedule/check loop)."""
+        if sum(n.max_slots for n in self.nodes) < 1:
+            raise ValueError("ResourceManager needs at least one slot "
+                             f"(hosts={[(n.host, n.max_slots) for n in self.nodes]})")
+        while self.experiment_queue or self.running:
+            while self.experiment_queue:
+                reservation = self._reserve(1)
+                if reservation is None:
+                    break
+                exp = self.experiment_queue.pop(0)
+                t = threading.Thread(
+                    target=self._run_experiment,
+                    args=(exp, reservation, user_script, list(user_args)),
+                    daemon=True)
+                t.start()
+                self.running[exp["exp_id"]] = (t, exp, reservation)
+            for exp_id in list(self.running):
+                t, exp, reservation = self.running[exp_id]
+                t.join(timeout=poll_s)
+                if not t.is_alive():
+                    reservation.release()
+                    del self.running[exp_id]
+        return self.finished
+
+    # -- selection --------------------------------------------------------
+    def best(self, metric: str = "throughput") -> Optional[Dict[str, Any]]:
+        """Highest-is-better over finished experiments (latency flips sign,
+        matching the in-process tuner)."""
+        best = None
+        for exp in self.finished.values():
+            m = exp.get("metrics") or {}
+            if metric == "latency":
+                val = -m["latency"] if "latency" in m else None
+            else:
+                val = m.get(metric)
+            if val is None:
+                continue
+            if best is None or val > best[0]:
+                best = (val, exp)
+        if best is None:
+            return None
+        val, exp = best
+        clean = copy.deepcopy(exp["ds_config"])
+        clean.pop("autotuning", None)
+        return {"name": exp["name"], "metric": val, "ds_config": clean,
+                "metrics": exp.get("metrics")}

@@ -155,3 +155,110 @@ def test_engine_writes_metric_file(tmp_path):
         m = json.load(f)
     assert m["throughput"] > 0
     assert m["steps"] == 2
+
+
+# ---------------------------------------------------------------------------
+# round 2: ResourceManager — real subprocess experiments, measured metrics
+# ---------------------------------------------------------------------------
+TOY_SCRIPT = '''
+import os, numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import flax.linen as nn
+import deepspeed_tpu as ds
+
+
+class Toy(nn.Module):
+    @nn.compact
+    def __call__(self, batch):
+        x = batch["x"]
+        y = nn.Dense(16)(jax.nn.relu(nn.Dense(16)(x)))
+        return jnp.mean((y - batch["y"]) ** 2)
+
+
+# config comes from DS_AUTOTUNING_CONFIG (engine reads the env override)
+engine, _, _, _ = ds.initialize(model=Toy(), config={
+    "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+rng = np.random.default_rng(0)
+batch = {"x": rng.standard_normal((engine.train_batch_size(), 8)).astype("float32"),
+         "y": rng.standard_normal((engine.train_batch_size(), 16)).astype("float32")}
+for _ in range(64):  # DS_AUTOTUNING_EXIT ends the run after the window
+    engine.train_batch(batch=batch)
+'''
+
+
+class TestResourceManager:
+    def test_node_reservations(self):
+        from deepspeed_tpu.autotuning import Node
+
+        n = Node("h1", 2)
+        a = n.reserve(1)
+        b = n.reserve(1)
+        assert a == [0] and b == [1]
+        assert n.reserve(1) is None
+        n.release(a)
+        assert n.reserve(1) == [0]
+
+    def test_end_to_end_real_experiments(self, tmp_path):
+        """VERDICT done-criterion: an end-to-end tune over a toy model with
+        REAL measured metrics — each experiment is a subprocess run of the
+        user script; throughput comes from the engine's profile window."""
+        from deepspeed_tpu.autotuning import ResourceManager
+
+        script = tmp_path / "train_toy.py"
+        script.write_text(TOY_SCRIPT)
+        exps = []
+        for stage in (0, 1):
+            exps.append({
+                "name": f"z{stage}",
+                "ds_config": {
+                    "train_micro_batch_size_per_gpu": 2,
+                    "zero_optimization": {"stage": stage},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "autotuning": {"enabled": True,
+                                   "start_profile_step": 2,
+                                   "end_profile_step": 4},
+                },
+            })
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        manager = ResourceManager(
+            hosts={"localhost": 1},
+            results_dir=str(tmp_path / "results"),
+            exps_dir=str(tmp_path / "exps"),
+            env={"JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": repo_root + os.pathsep +
+                 os.environ.get("PYTHONPATH", "")})
+        manager.schedule_experiments(exps)
+        finished = manager.run(str(script), [])
+        assert len(finished) == 2
+        for exp in finished.values():
+            assert exp["returncode"] == 0, \
+                open(os.path.join(exp["result_dir"], "stderr.log")).read()[-2000:]
+            assert exp["metrics"] is not None
+            assert exp["metrics"]["throughput"] > 0
+            assert exp["metrics"]["steps"] == 2
+        best = manager.best("throughput")
+        assert best is not None
+        assert best["name"] in ("z0", "z1")
+        assert "autotuning" not in best["ds_config"]
+
+        # resume: re-scheduling the same experiments skips both runs
+        m2 = ResourceManager(
+            hosts={"localhost": 1},
+            results_dir=str(tmp_path / "results"),
+            exps_dir=str(tmp_path / "exps"))
+        m2.schedule_experiments(exps)
+        assert not m2.experiment_queue
+        assert len(m2.finished) == 2
+
+    def test_arg_mappings_rewrite(self, tmp_path):
+        from deepspeed_tpu.autotuning.scheduler import _get_by_dotted_key
+
+        cfg = {"train_micro_batch_size_per_gpu": 4,
+               "zero_optimization": {"stage": 2}}
+        assert _get_by_dotted_key(cfg, "train_micro_batch_size_per_gpu") == 4
+        assert _get_by_dotted_key(cfg, "zero_optimization.stage") == 2
+        assert _get_by_dotted_key(cfg, "zero_optimization.missing") is None
