@@ -237,17 +237,35 @@ def run_autotuning(args, active_resources) -> None:
 
     from .scheduler import ResourceManager
 
-    hosts = {h: max(1, len(v) if isinstance(v, (list, tuple)) else int(v))
-             for h, v in (active_resources or {"localhost": 1}).items()}
+    # experiments execute as LOCAL subprocesses (remote-host dispatch is not
+    # implemented): concurrency = the first host's slot count, never the
+    # cluster-wide sum, or the local machine would be oversubscribed and the
+    # measured metrics would be garbage
+    resources = active_resources or {"localhost": 1}
+    if len(resources) > 1:
+        logger.warning(
+            "autotuning experiments run on the local host only; using the "
+            f"first of {len(resources)} hosts for the concurrency limit")
+    first = next(iter(resources.values()))
+    slots = max(1, len(first) if isinstance(first, (list, tuple))
+                else int(first))
     manager = ResourceManager(
-        hosts=hosts, results_dir=results_dir, exps_dir=at_cfg.exps_dir,
-        arg_mappings=at_cfg.arg_mappings,
+        hosts={"localhost": slots}, results_dir=results_dir,
+        exps_dir=at_cfg.exps_dir, arg_mappings=at_cfg.arg_mappings,
         master_port=getattr(args, "master_port", 29500))
     manager.schedule_experiments(exps)
     finished = manager.run(args.user_script, list(args.user_args))
 
+    def norm_metric(e):
+        """Higher-is-better normalization (latency flips sign), matching
+        both the in-process tuner and manager.best()."""
+        m = e.get("metrics") or {}
+        if at_cfg.metric == "latency":
+            return -m["latency"] if "latency" in m else None
+        return m.get(at_cfg.metric)
+
     results = [{"name": e["name"],
-                "metric": (e.get("metrics") or {}).get(at_cfg.metric),
+                "metric": norm_metric(e),
                 "returncode": e.get("returncode"),
                 "reservation": e.get("reservation")}
                for e in finished.values()]

@@ -11,10 +11,11 @@ reservations, runs as many experiments concurrently as there are idle slots
 already exist (resume), applies the reference's ``arg_mappings`` rewrite
 of user CLI args with tuned values, and collects metrics for the tuner.
 
-Differences from the reference, by design: slots are TPU hosts (one JAX
-process drives all local chips), not per-GPU ranks; remote hosts launch
-through the same multinode runners the launcher uses — on one host the
-subprocess path is exercised end-to-end in tests/unit/autotuning.
+Differences from the reference, by design: slots are concurrency tokens on
+the LOCAL host (one JAX process drives all local chips; experiments are
+always local subprocesses — remote-host dispatch is not implemented, so
+callers must size the pool to this machine). The subprocess path is
+exercised end-to-end in tests/unit/autotuning.
 """
 
 from __future__ import annotations

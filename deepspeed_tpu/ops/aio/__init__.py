@@ -3,6 +3,12 @@ handle.cpp`` API: async_pread/async_pwrite/wait), ctypes-bound.
 
 Used by the NVMe offload tier (``runtime/zero/offload.py``) to swap
 optimizer-state / parameter buffers against local SSD with overlapped I/O.
+Configuration mirrors the reference's ``aio`` JSON block: ``block_size``
+(chunking granularity — every request fans out into block-size chunks
+across the thread pool), ``queue_depth`` (max queued chunks; backpressure),
+``thread_count``, and O_DIRECT routing for aligned chunks.
+``single_submit``/``overlap_events`` are accepted for config parity but are
+no-ops in the thread-pool model (chunk submission is always overlapped).
 """
 
 from __future__ import annotations
@@ -15,12 +21,39 @@ import numpy as np
 from ..op_builder import AsyncIOBuilder
 
 
-class AioHandle:
-    """Thread-pool async file I/O. numpy-array in/out, byte offsets."""
+def aligned_array(nbytes: int, dtype=np.uint8, align: int = 4096) -> np.ndarray:
+    """A numpy buffer whose data pointer is ``align``-aligned — required for
+    chunks to take the O_DIRECT path (the reference's pinned aligned
+    tensors, csrc/aio/py_lib/deepspeed_pin_tensor.cpp)."""
+    itemsize = np.dtype(dtype).itemsize
+    n = (nbytes + itemsize - 1) // itemsize
+    raw = np.empty(n * itemsize + align, np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + n * itemsize].view(dtype)
 
-    def __init__(self, num_threads: int = 4):
+
+class AioHandle:
+    """Thread-pool async file I/O. numpy-array in/out, byte offsets.
+
+    Args mirror the reference handle (aio_bench vocabulary): block_size,
+    queue_depth, thread_count, single_submit, overlap_events, o_direct.
+    """
+
+    def __init__(self, num_threads: int = 4, block_size: int = 1 << 20,
+                 queue_depth: int = 0, o_direct: bool = False,
+                 single_submit: bool = False, overlap_events: bool = True):
+        del single_submit, overlap_events  # parity-only (see module doc)
+        if block_size < 4096:
+            raise ValueError(
+                f"block_size must be >= 4096 bytes, got {block_size} (the "
+                f"chunking granularity; O_DIRECT alignment unit)")
         self._lib = AsyncIOBuilder().load()
-        self._h = self._lib.ds_aio_create(num_threads)
+        self._h = self._lib.ds_aio_create(num_threads, block_size,
+                                          queue_depth, int(o_direct))
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.num_threads = num_threads
+        self.o_direct = o_direct
         self._refs = []  # keep submitted buffers alive until wait()
 
     def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> None:
@@ -35,13 +68,23 @@ class AioHandle:
         self._lib.ds_aio_pread(self._h, os.fsencode(path),
                                array.ctypes.data, array.nbytes, offset)
 
+    # reference-named blocking variants (deepspeed_py_aio_handle's sync_*
+    # calls return only after the I/O completes)
+    def sync_pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> None:
+        self.async_pwrite(array, path, offset)
+        self.wait()
+
+    def sync_pread(self, array: np.ndarray, path: str, offset: int = 0) -> None:
+        self.async_pread(array, path, offset)
+        self.wait()
+
     def wait(self) -> int:
         """Blocks until all pending requests finish; returns the number of
-        FAILED requests (0 = success), raising on failure."""
+        FAILED chunks (0 = success), raising on failure."""
         errors = self._lib.ds_aio_wait(self._h)
         self._refs.clear()
         if errors:
-            raise IOError(f"aio: {errors} request(s) failed")
+            raise IOError(f"aio: {errors} chunk(s) failed")
         return 0
 
     def pending(self) -> int:

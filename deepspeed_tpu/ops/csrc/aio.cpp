@@ -1,13 +1,21 @@
 // Async file I/O for the NVMe offload tier (ZeRO-Infinity swap).
 //
-// TPU-native equivalent of the reference's csrc/aio/ library: a worker
-// thread pool draining a request queue of pread/pwrite jobs against local
-// SSD, with a wait() barrier — the same handle contract as
-// deepspeed_aio_thread_t (csrc/aio/py_lib/deepspeed_aio_thread.h:41) and
-// deepspeed_py_aio_handle (async_pread/async_pwrite/wait). Plain
-// pread64/pwrite64 on buffered fds instead of libaio+O_DIRECT: TPU-VM local
-// SSD sustains its bandwidth through the page cache, and the queue-depth
-// parallelism comes from the thread count.
+// TPU-native equivalent of the reference's csrc/aio/ library
+// (deepspeed_aio_thread_t work/complete queues, deepspeed_py_aio_handle
+// async_pread/async_pwrite/wait, O_DIRECT + block_size + queue_depth
+// config). Design:
+//
+// * every request is SPLIT into block_size chunks fanned across the worker
+//   thread pool — one large swap read/write saturates the device with
+//   queue-depth parallel chunk I/Os (the role libaio iodepth plays in the
+//   reference);
+// * O_DIRECT (optional): chunks whose (offset, size, buffer address) are
+//   all 4096-aligned go through an O_DIRECT fd, bypassing the page cache —
+//   the reference's alignment contract (csrc/aio/common/); misaligned
+//   chunks (tails, odd buffers) fall back to the buffered fd of the same
+//   file;
+// * queue_depth bounds the number of queued chunks — submit blocks when
+//   the queue is full (backpressure instead of unbounded memory).
 //
 // C ABI, ctypes-bound.
 
@@ -21,6 +29,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -28,9 +37,20 @@
 
 namespace {
 
+constexpr int64_t kAlign = 4096;
+
+struct FileHandles {
+  int fd_buffered = -1;
+  int fd_direct = -1;
+  ~FileHandles() {
+    if (fd_buffered >= 0) ::close(fd_buffered);
+    if (fd_direct >= 0) ::close(fd_direct);
+  }
+};
+
 struct Request {
   bool write;
-  std::string path;
+  std::shared_ptr<FileHandles> files;
   void* buf;
   int64_t nbytes;
   int64_t offset;
@@ -42,8 +62,12 @@ struct Handle {
   std::mutex mu;
   std::condition_variable cv_work;
   std::condition_variable cv_done;
+  std::condition_variable cv_space;
   int64_t inflight = 0;
   int64_t completed = 0;
+  int64_t block_size = 1 << 20;
+  int64_t queue_limit = 0;  // 0 = unbounded
+  bool o_direct = false;
   std::atomic<int64_t> errors{0};
   bool shutdown = false;
 
@@ -56,6 +80,7 @@ struct Handle {
         if (shutdown && queue.empty()) return;
         req = std::move(queue.front());
         queue.pop_front();
+        cv_space.notify_all();
       }
       if (!run_one(req)) errors.fetch_add(1);
       {
@@ -67,27 +92,28 @@ struct Handle {
     }
   }
 
+  static bool aligned(const Request& req) {
+    return req.offset % kAlign == 0 && req.nbytes % kAlign == 0 &&
+           reinterpret_cast<uintptr_t>(req.buf) % kAlign == 0;
+  }
+
   static bool run_one(const Request& req) {
-    int flags = req.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
-    int fd = ::open(req.path.c_str(), flags, 0644);
+    int fd = (req.files->fd_direct >= 0 && aligned(req))
+                 ? req.files->fd_direct
+                 : req.files->fd_buffered;
     if (fd < 0) return false;
     char* p = static_cast<char*>(req.buf);
     int64_t left = req.nbytes;
     int64_t off = req.offset;
-    bool ok = true;
     while (left > 0) {
       ssize_t r = req.write ? ::pwrite64(fd, p, left, off)
                             : ::pread64(fd, p, left, off);
-      if (r <= 0) {
-        ok = false;
-        break;
-      }
+      if (r <= 0) return false;
       p += r;
       off += r;
       left -= r;
     }
-    ::close(fd);
-    return ok;
+    return true;
   }
 };
 
@@ -95,9 +121,16 @@ struct Handle {
 
 extern "C" {
 
-void* ds_aio_create(int num_threads) {
+// block_size: chunking granularity (bytes, >= 4096); queue_depth: max
+// queued chunks (0 = unbounded); o_direct: route aligned chunks through
+// O_DIRECT.
+void* ds_aio_create(int num_threads, int64_t block_size, int64_t queue_depth,
+                    int o_direct) {
   auto* h = new Handle();
   if (num_threads < 1) num_threads = 1;
+  if (block_size >= 4096) h->block_size = block_size;
+  h->queue_limit = queue_depth > 0 ? queue_depth : 0;
+  h->o_direct = o_direct != 0;
   for (int i = 0; i < num_threads; ++i)
     h->workers.emplace_back([h] { h->worker_loop(); });
   return h;
@@ -116,12 +149,30 @@ void ds_aio_destroy(void* handle) {
 
 static void submit(Handle* h, bool write, const char* path, void* buf,
                    int64_t nbytes, int64_t offset) {
-  {
-    std::unique_lock<std::mutex> lock(h->mu);
-    h->queue.push_back(Request{write, path, buf, nbytes, offset});
-    ++h->inflight;
-  }
-  h->cv_work.notify_one();
+  auto files = std::make_shared<FileHandles>();
+  int flags = write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+  files->fd_buffered = ::open(path, flags, 0644);
+#ifdef O_DIRECT
+  if (h->o_direct) files->fd_direct = ::open(path, flags | O_DIRECT, 0644);
+#endif
+  // split into block_size chunks; each chunk is an independent queue entry
+  int64_t pos = 0;
+  do {
+    int64_t len = nbytes - pos < h->block_size ? nbytes - pos : h->block_size;
+    Request req{write, files, static_cast<char*>(buf) + pos, len,
+                offset + pos};
+    {
+      std::unique_lock<std::mutex> lock(h->mu);
+      h->cv_space.wait(lock, [&] {
+        return h->queue_limit == 0 ||
+               static_cast<int64_t>(h->queue.size()) < h->queue_limit;
+      });
+      h->queue.push_back(std::move(req));
+      ++h->inflight;
+    }
+    h->cv_work.notify_one();
+    pos += len;
+  } while (pos < nbytes);
 }
 
 void ds_aio_pread(void* handle, const char* path, void* buf, int64_t nbytes,
@@ -136,7 +187,7 @@ void ds_aio_pwrite(void* handle, const char* path, const void* buf,
 }
 
 // Blocks until all submitted requests complete. Returns the number of
-// failed requests since the last wait (0 = success).
+// failed chunks since the last wait (0 = success).
 int64_t ds_aio_wait(void* handle) {
   auto* h = static_cast<Handle*>(handle);
   std::unique_lock<std::mutex> lock(h->mu);

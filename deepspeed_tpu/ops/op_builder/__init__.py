@@ -120,7 +120,8 @@ class AsyncIOBuilder(OpBuilder):
     SOURCES = ["aio.cpp"]
 
     def _declare(self, lib):
-        lib.ds_aio_create.argtypes = [ctypes.c_int]
+        lib.ds_aio_create.argtypes = [ctypes.c_int, ctypes.c_int64,
+                                      ctypes.c_int64, ctypes.c_int]
         lib.ds_aio_create.restype = ctypes.c_void_p
         lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
         lib.ds_aio_destroy.restype = None

@@ -376,7 +376,8 @@ class DeepSpeedEngine:
             self._offload_opt = OffloadedOptimizer(
                 jax.device_get(jax.tree_util.tree_map(
                     lambda p: np.asarray(p), params_host)),
-                opt_params, self._offload_cfg)
+                opt_params, self._offload_cfg,
+                aio_config=self._config.aio)
             master = None
             opt_state = None
         else:

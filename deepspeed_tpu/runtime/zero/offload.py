@@ -56,8 +56,9 @@ class OffloadedOptimizer:
 
     def __init__(self, params_host, opt_params: Dict,
                  config: DeepSpeedZeroOffloadOptimizerConfig,
-                 compute_dtype=None):
+                 compute_dtype=None, aio_config=None):
         self.config = config
+        self._aio_config = aio_config
         self.nvme = config.device == OffloadDeviceEnum.nvme
         betas = tuple(opt_params.get("betas", (0.9, 0.999)))
         self.opt = DeepSpeedCPUAdam(
@@ -94,7 +95,22 @@ class OffloadedOptimizer:
 
             self.nvme_dir = config.nvme_path or "/tmp/ds_tpu_nvme"
             os.makedirs(self.nvme_dir, exist_ok=True)
-            self._aio = AioHandle(num_threads=max(1, config.buffer_count))
+            ac = self._aio_config
+            # aio.thread_count only overrides the historical buffer_count
+            # sizing when the user actually set it (the config model always
+            # materializes with defaults)
+            ac_set = set()
+            if ac is not None:
+                ac_set = getattr(ac, "model_fields_set",
+                                 getattr(ac, "__fields_set__", set()))
+            threads = ac.thread_count if "thread_count" in ac_set \
+                else max(1, config.buffer_count)
+            self._aio = AioHandle(
+                num_threads=max(1, threads),
+                block_size=ac.block_size if ac else 1 << 20,
+                queue_depth=ac.queue_depth if ac else 0,
+                single_submit=ac.single_submit if ac else False,
+                overlap_events=ac.overlap_events if ac else True)
             self._swap_out_all()
 
     # --- nvme swap ------------------------------------------------------
