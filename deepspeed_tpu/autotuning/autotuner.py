@@ -256,16 +256,10 @@ def run_autotuning(args, active_resources) -> None:
     manager.schedule_experiments(exps)
     finished = manager.run(args.user_script, list(args.user_args))
 
-    def norm_metric(e):
-        """Higher-is-better normalization (latency flips sign), matching
-        both the in-process tuner and manager.best()."""
-        m = e.get("metrics") or {}
-        if at_cfg.metric == "latency":
-            return -m["latency"] if "latency" in m else None
-        return m.get(at_cfg.metric)
+    from .scheduler import normalized_metric
 
     results = [{"name": e["name"],
-                "metric": norm_metric(e),
+                "metric": normalized_metric(e.get("metrics"), at_cfg.metric),
                 "returncode": e.get("returncode"),
                 "reservation": e.get("reservation")}
                for e in finished.values()]

@@ -33,6 +33,16 @@ from ..runtime.config_utils import get_nested as _get_by_dotted_key
 from ..utils.logging import logger
 
 
+def normalized_metric(metrics: Optional[Dict[str, Any]],
+                      metric: str) -> Optional[float]:
+    """Higher-is-better normalization shared by best() and the results
+    file: latency flips sign, everything else is read as-is."""
+    m = metrics or {}
+    if metric == "latency":
+        return -m["latency"] if "latency" in m else None
+    return m.get(metric)
+
+
 class Node:
     def __init__(self, host: str, slots: int):
         self.host = host
@@ -209,11 +219,7 @@ class ResourceManager:
         matching the in-process tuner)."""
         best = None
         for exp in self.finished.values():
-            m = exp.get("metrics") or {}
-            if metric == "latency":
-                val = -m["latency"] if "latency" in m else None
-            else:
-                val = m.get(metric)
+            val = normalized_metric(exp.get("metrics"), metric)
             if val is None:
                 continue
             if best is None or val > best[0]:

@@ -102,5 +102,13 @@ class AioHandle:
             pass
 
 
+def o_direct_supported(path: str) -> bool:
+    """True when the filesystem holding ``path`` accepts O_DIRECT opens —
+    tmpfs and some network filesystems do not, in which case the handle
+    silently serves every chunk from the buffered fd."""
+    lib = AsyncIOBuilder().load()
+    return bool(lib.ds_aio_probe_o_direct(os.fsencode(path)))
+
+
 def aio_available() -> bool:
     return AsyncIOBuilder().is_compatible()

@@ -201,4 +201,18 @@ int64_t ds_aio_pending(void* handle) {
   return h->inflight;
 }
 
+// 1 when the filesystem holding `path` accepts O_DIRECT opens (tmpfs and
+// some network filesystems return EINVAL, in which case chunks silently
+// use the buffered fd) — lets callers report o_direct_effective honestly.
+int ds_aio_probe_o_direct(const char* path) {
+#ifdef O_DIRECT
+  int fd = ::open(path, O_RDONLY | O_DIRECT);
+  if (fd >= 0) {
+    ::close(fd);
+    return 1;
+  }
+#endif
+  return 0;
+}
+
 }  // extern "C"

@@ -133,6 +133,8 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_wait.restype = ctypes.c_int64
         lib.ds_aio_pending.argtypes = [ctypes.c_void_p]
         lib.ds_aio_pending.restype = ctypes.c_int64
+        lib.ds_aio_probe_o_direct.argtypes = [ctypes.c_char_p]
+        lib.ds_aio_probe_o_direct.restype = ctypes.c_int
 
 
 ALL_OPS = {

@@ -1,5 +1,6 @@
+from .data_analyzer import DataAnalyzer
 from .data_sampler import DeepSpeedDataSampler
 from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
 
-__all__ = ["DeepSpeedDataSampler", "MMapIndexedDataset",
+__all__ = ["DataAnalyzer", "DeepSpeedDataSampler", "MMapIndexedDataset",
            "MMapIndexedDatasetBuilder"]

@@ -1,0 +1,166 @@
+"""Offline data analyzer — difficulty indexing for curriculum learning.
+
+Capability parity with reference ``deepspeed/runtime/data_pipeline/
+data_sampling/data_analyzer.py`` (``DataAnalyzer.run_map`` :180 /
+``run_reduce`` :411): computes user metric functions over every sample of
+a dataset ahead of training and writes the index files the curriculum
+sampler consumes. The map phase shards the dataset over (num_workers ×
+num_threads) and writes one partial result per shard; the reduce phase
+merges shards into:
+
+* ``{metric}_sample_to_metric.npy`` — per-sample metric value, aligned to
+  dataset order (what ``DeepSpeedDataSampler`` needs),
+* ``{metric}_metric_to_sample.json`` — metric value → sample ids (the
+  reference's metric_to_sample index used for value-bucketed sampling),
+* ``{metric}_meta.json`` — min/max/count.
+
+The reference stores these as mmap indexed datasets + CSVs; npy/json hold
+the same information at the scales the sampler reads once per run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ....utils.logging import log_dist
+
+
+class DataAnalyzer:
+    """Map/reduce difficulty indexing (reference data_analyzer.py:20).
+
+    Args:
+      dataset: indexable dataset (``__len__`` + ``__getitem__``).
+      metric_functions: {metric name: fn(sample) -> scalar} — e.g. sequence
+        length or vocabulary rarity (reference passes a list; a dict names
+        the output files).
+      save_path: output directory for the index files.
+      num_workers/worker_id: shard the map phase across processes or hosts;
+        each worker covers samples [worker_id::num_workers].
+      num_threads: intra-worker parallelism of the map phase.
+    """
+
+    def __init__(self, dataset, metric_functions: Dict[str, Callable[[Any], float]],
+                 save_path: str, num_workers: int = 1, worker_id: int = 0,
+                 num_threads: int = 1, batch_size: int = 1024):
+        assert metric_functions, "need at least one metric function"
+        self.dataset = dataset
+        self.metric_functions = dict(metric_functions)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.num_threads = max(1, num_threads)
+        self.batch_size = batch_size
+        os.makedirs(save_path, exist_ok=True)
+
+    # -- map phase --------------------------------------------------------
+    def _worker_indices(self) -> np.ndarray:
+        return np.arange(self.worker_id, len(self.dataset), self.num_workers)
+
+    def _shard_file(self, metric: str, worker_id: int) -> str:
+        return os.path.join(self.save_path,
+                            f"{metric}_worker{worker_id}_map.npz")
+
+    def run_map(self) -> None:
+        """Compute every metric over this worker's shard and persist the
+        partial (sample_id, value) arrays."""
+        indices = self._worker_indices()
+
+        def eval_chunk(chunk: np.ndarray) -> Dict[str, List[float]]:
+            out: Dict[str, List[float]] = {m: [] for m in self.metric_functions}
+            for i in chunk:
+                sample = self.dataset[int(i)]
+                for m, fn in self.metric_functions.items():
+                    out[m].append(float(fn(sample)))
+            return out
+
+        chunks = [indices[i:i + self.batch_size]
+                  for i in range(0, len(indices), self.batch_size)]
+        results: Dict[str, List[float]] = {m: [] for m in self.metric_functions}
+        if self.num_threads > 1 and len(chunks) > 1:
+            with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
+                for part in pool.map(eval_chunk, chunks):
+                    for m, vals in part.items():
+                        results[m].extend(vals)
+        else:
+            for chunk in chunks:
+                for m, vals in eval_chunk(chunk).items():
+                    results[m].extend(vals)
+
+        for metric, vals in results.items():
+            np.savez(self._shard_file(metric, self.worker_id),
+                     sample_ids=indices, values=np.asarray(vals, np.float64))
+        log_dist(f"data analyzer map: worker {self.worker_id}/"
+                 f"{self.num_workers} indexed {len(indices)} samples "
+                 f"({list(self.metric_functions)})", ranks=[0])
+
+    # -- reduce phase -----------------------------------------------------
+    def run_reduce(self) -> Dict[str, np.ndarray]:
+        """Merge all workers' partial results into the final index files.
+        Returns {metric: per-sample values} for in-process use."""
+        merged: Dict[str, np.ndarray] = {}
+        n = len(self.dataset)
+        for metric in self.metric_functions:
+            values = np.zeros(n, np.float64)
+            seen = np.zeros(n, bool)  # explicit mask: NaN is a legal value
+            for w in range(self.num_workers):
+                path = self._shard_file(metric, w)
+                if not os.path.exists(path):
+                    raise FileNotFoundError(
+                        f"missing map shard {path} — run_map every worker "
+                        f"before run_reduce")
+                part = np.load(path)
+                values[part["sample_ids"]] = part["values"]
+                seen[part["sample_ids"]] = True
+            if not seen.all():
+                missing = np.nonzero(~seen)[0]
+                raise RuntimeError(
+                    f"metric {metric}: {missing.size} samples were never "
+                    f"indexed (first missing ids {missing[:5].tolist()}) — "
+                    f"did every worker run_map with the same num_workers?")
+            np.save(self._sample_to_metric_path(self.save_path, metric),
+                    values)
+            # metric value -> sample ids (reference metric_to_sample index);
+            # keys are plain repr(float) so numpy 1.x/2.x hosts agree and
+            # consumers can float() them back
+            m2s: Dict[str, List[int]] = {}
+            for idx, v in enumerate(values):
+                m2s.setdefault(repr(float(v)), []).append(idx)
+            with open(os.path.join(self.save_path,
+                                   f"{metric}_metric_to_sample.json"),
+                      "w") as f:
+                json.dump(m2s, f)
+            with open(os.path.join(self.save_path, f"{metric}_meta.json"),
+                      "w") as f:
+                json.dump({"min": float(values.min()),
+                           "max": float(values.max()),
+                           "count": int(n)}, f)
+            merged[metric] = values
+        log_dist(f"data analyzer reduce: wrote indexes for "
+                 f"{list(self.metric_functions)} to {self.save_path}",
+                 ranks=[0])
+        return merged
+
+    def run_map_reduce(self) -> Dict[str, np.ndarray]:
+        """Single-process convenience: map this worker (must be the only
+        one) then reduce."""
+        assert self.num_workers == 1, \
+            "run_map_reduce is single-worker; run run_map per worker then " \
+            "run_reduce once"
+        self.run_map()
+        return self.run_reduce()
+
+    # -- consumption ------------------------------------------------------
+    @staticmethod
+    def _sample_to_metric_path(save_path: str, metric: str) -> str:
+        return os.path.join(save_path, f"{metric}_sample_to_metric.npy")
+
+    @staticmethod
+    def load_metric_values(save_path: str, metric: str) -> np.ndarray:
+        """Read a metric's per-sample values (what DeepSpeedDataSampler
+        takes as ``metric_values[name]``)."""
+        return np.load(DataAnalyzer._sample_to_metric_path(save_path, metric))

@@ -15,6 +15,7 @@ Metric modes (reference constants):
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -64,10 +65,25 @@ class DeepSpeedDataSampler:
             if isinstance(metric_values, dict):
                 for name, vals in metric_values.items():
                     self.metric_values[name] = np.asarray(vals)
+            for name, mcfg in metrics.items():
+                if name in self.metric_values:
+                    continue
+                # load the offline data analyzer's index when configured
+                # (reference: sample_to_metric index files)
+                path = mcfg.get("sample_to_metric_path")
+                if path:
+                    from .data_analyzer import DataAnalyzer
+
+                    if os.path.isdir(path):
+                        self.metric_values[name] = \
+                            DataAnalyzer.load_metric_values(path, name)
+                    else:
+                        self.metric_values[name] = np.load(path)
             for name in self.curriculum_schedulers:
                 assert name in self.metric_values, \
-                    f"metric values for '{name}' are required (the offline " \
-                    f"data analyzer produces them)"
+                    f"metric values for '{name}' are required — run the " \
+                    f"offline DataAnalyzer and pass metric_values or set " \
+                    f"sample_to_metric_path"
         self.np_rng = self.rng
 
     def __len__(self) -> int:

@@ -318,3 +318,97 @@ def test_engine_curriculum_seqlen_truncation():
         engine.train_batch(batch=batch())
     assert min(seen_lens) <= 8, seen_lens   # truncated early
     assert max(seen_lens) == 16, seen_lens  # full length by the end
+
+
+# ---------------------------------------------------------------------------
+# round 2: offline data analyzer (reference data_analyzer.py analog)
+# ---------------------------------------------------------------------------
+class TestDataAnalyzer:
+    def _dataset(self, n=40):
+        rng = np.random.default_rng(0)
+        # variable-length "token" samples: seqlen is the natural difficulty
+        return [rng.integers(0, 100, rng.integers(4, 32)).tolist()
+                for _ in range(n)]
+
+    def test_map_reduce_single_worker(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+            DataAnalyzer,
+        )
+
+        ds = self._dataset()
+        analyzer = DataAnalyzer(
+            ds, {"seqlen": len, "vocab_max": lambda s: max(s)},
+            save_path=str(tmp_path), num_threads=2, batch_size=8)
+        merged = analyzer.run_map_reduce()
+        np.testing.assert_array_equal(merged["seqlen"],
+                                      [len(s) for s in ds])
+        np.testing.assert_array_equal(merged["vocab_max"],
+                                      [max(s) for s in ds])
+        # persisted artifacts load back identically
+        loaded = DataAnalyzer.load_metric_values(str(tmp_path), "seqlen")
+        np.testing.assert_array_equal(loaded, merged["seqlen"])
+        import json as _json
+
+        meta = _json.load(open(tmp_path / "seqlen_meta.json"))
+        assert meta["count"] == len(ds)
+        assert meta["min"] == min(len(s) for s in ds)
+        m2s = _json.load(open(tmp_path / "seqlen_metric_to_sample.json"))
+        # every sample id appears exactly once across the value buckets
+        all_ids = sorted(i for ids in m2s.values() for i in ids)
+        assert all_ids == list(range(len(ds)))
+
+    def test_multi_worker_shards_merge(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+            DataAnalyzer,
+        )
+
+        ds = self._dataset(31)
+        for w in range(3):
+            DataAnalyzer(ds, {"seqlen": len}, save_path=str(tmp_path),
+                         num_workers=3, worker_id=w).run_map()
+        merged = DataAnalyzer(ds, {"seqlen": len}, save_path=str(tmp_path),
+                              num_workers=3).run_reduce()
+        np.testing.assert_array_equal(merged["seqlen"],
+                                      [len(s) for s in ds])
+
+    def test_reduce_missing_shard_raises(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+            DataAnalyzer,
+        )
+
+        ds = self._dataset(10)
+        DataAnalyzer(ds, {"seqlen": len}, save_path=str(tmp_path),
+                     num_workers=2, worker_id=0).run_map()
+        with pytest.raises(FileNotFoundError):
+            DataAnalyzer(ds, {"seqlen": len}, save_path=str(tmp_path),
+                         num_workers=2).run_reduce()
+
+    def test_sampler_loads_analyzer_index(self, tmp_path):
+        """The curriculum sampler auto-loads the analyzer's
+        sample_to_metric index from the configured path."""
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+            DataAnalyzer,
+            DeepSpeedDataSampler,
+        )
+
+        ds = self._dataset(32)
+        DataAnalyzer(ds, {"seqlen": len},
+                     save_path=str(tmp_path)).run_map_reduce()
+        sampler = DeepSpeedDataSampler(
+            {"curriculum_learning": {
+                "enabled": True,
+                "curriculum_metrics": {
+                    "seqlen": {
+                        "sample_to_metric_path": str(tmp_path),
+                        "difficulty_type": "value",
+                        "min_difficulty": 8, "max_difficulty": 32,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 4,
+                                            "difficulty_step": 8},
+                    }}}},
+            one_epoch_total_samples=len(ds), micro_batch_size=2,
+            data_parallel_rank=0, data_parallel_size=1)
+        batch = sampler.get_next_global_batch()
+        # early curriculum: only short sequences eligible
+        assert all(len(ds[i]) <= 8 for i in batch), \
+            [len(ds[i]) for i in batch]
