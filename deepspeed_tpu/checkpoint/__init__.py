@@ -1,4 +1,5 @@
 from .deepspeed_checkpoint import DeepSpeedCheckpoint
+from .gpt2_import import megatron_gpt2_to_flax
 from .reshape_3d_utils import get_model_3d_descriptor, model_3d_desc
 from .reshape_meg_2d import meg_2d_parallel_map, reshape_meg_2d_parallel
 from .universal_checkpoint import ds_to_universal, load_universal, universal_dir
@@ -12,5 +13,5 @@ __all__ = [
     "get_fp32_state_dict_from_zero_checkpoint",
     "convert_zero_checkpoint_to_fp32_state_dict",
     "DeepSpeedCheckpoint", "meg_2d_parallel_map", "reshape_meg_2d_parallel",
-    "model_3d_desc", "get_model_3d_descriptor",
+    "model_3d_desc", "get_model_3d_descriptor", "megatron_gpt2_to_flax",
 ]

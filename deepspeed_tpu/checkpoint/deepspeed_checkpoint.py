@@ -239,6 +239,49 @@ class DeepSpeedCheckpoint:
             t_list.append({k: _to_numpy(v) for k, v in sd.items()})
         return t_list
 
+    def checkpoint_version(self) -> float:
+        """Megatron checkpoint_version from the mp_rank state (0.0 when
+        absent) — decides the qkv shard layout (state_dict_factory.py)."""
+        sd = self._load_mp_rank_sd()
+        v = sd.get("checkpoint_version", 0.0)
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def merged_layer_state(self, layer_key: str,
+                           ckpt_version: Optional[float] = None
+                           ) -> Dict[str, np.ndarray]:
+        """One layer's state with ALL original-tp shards merged whole
+        (qkv/row/col concat rules) — the building block of to_universal and
+        of model importers.
+
+        ``query_key_value`` params get the version-aware regroup
+        (MegatronSDLoader.merge_query_key_value, reference
+        state_dict_factory.py:220): version-0 shards store [q_r|k_r|v_r]
+        fused per rank, so a naive dim-0 concat would interleave ranks'
+        q/k/v — each shard is split into thirds and re-concatenated per
+        component; versions >= 1.0 concat plainly. The version defaults to
+        the checkpoint's own ``checkpoint_version``."""
+        if ckpt_version is None:
+            ckpt_version = self.checkpoint_version()
+        layer_files = get_files_with_prefix(
+            self.layer_files, f"{LAYER_FILE_PREFIX}{layer_key}")
+        parts = partition_data(layer_files, self.original_tp_degree)
+        sds = [{k: _to_numpy(v) for k, v in _torch_load(fs[0]).items()}
+               for fs in parts]
+        if len(sds) == 1:
+            return sds[0]
+        merged = merge_state_dicts(sds, cat_dim_fn=get_layer_cat_dim)
+        from ..runtime.state_dict_factory import MegatronSDLoader
+
+        loader = MegatronSDLoader([], version=ckpt_version)
+        for key in merged:
+            if "query_key_value" in key:
+                merged[key] = loader.merge_query_key_value(
+                    [np.asarray(sd[key]) for sd in sds], dim=0)
+        return merged
+
     def get_pp_transformer_map(self, pp_index: int) -> List[str]:
         return self.pp_to_transformer_map[pp_index]
 
@@ -282,14 +325,8 @@ class DeepSpeedCheckpoint:
 
         merged: Dict[str, np.ndarray] = {}
         if self.layer_keys:
-            for i, layer_key in enumerate(self.layer_keys):
-                layer_files = get_files_with_prefix(
-                    self.layer_files, f"{LAYER_FILE_PREFIX}{layer_key}")
-                parts = partition_data(layer_files, self.original_tp_degree)
-                sds = [{k: _to_numpy(v) for k, v in _torch_load(fs[0]).items()}
-                       for fs in parts]
-                sd = sds[0] if len(sds) == 1 else \
-                    merge_state_dicts(sds, cat_dim_fn=get_layer_cat_dim)
+            for layer_key in self.layer_keys:
+                sd = self.merged_layer_state(layer_key)
                 for k, v in sd.items():
                     merged[f"layer_{layer_key}/{k.replace('.', '/')}"] = v
         else:

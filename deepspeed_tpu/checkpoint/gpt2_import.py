@@ -1,0 +1,103 @@
+"""Import a reference-format (Megatron-DeepSpeed) GPT-2 checkpoint into
+this framework's flax GPT-2 parameter tree.
+
+The migration counterpart of the reference's inference-time resharding
+(``runtime/state_dict_factory.py:190 MegatronSDLoader``) and AutoTP weight
+placement (``module_inject/auto_tp.py:13``): :class:`DeepSpeedCheckpoint`
+merges the TP/PP layer-file shards (qkv/row/col concat rules,
+deepspeed_checkpoint.get_layer_cat_dim), and this module renames + re-lays
+the merged torch tensors into ``models/gpt2.GPT2LMHeadModel``'s tree:
+
+* torch Linear weights are (out, in) → flax kernels (in, out): transpose;
+* ``query_key_value`` keeps the contiguous [q|k|v] layout (checkpoint
+  version 0 — matching models/gpt2's ``jnp.split(qkv, 3, axis=-1)``);
+* per-layer dicts stack into the (n_layer, ...) leaves of the nn.scan'd
+  block (metadata axis 0);
+* Megatron pads the vocab for TP divisibility — rows beyond
+  ``config.vocab_size`` are sliced off.
+
+Logits parity of the imported tree (tp=2 shards vs unsharded) is asserted
+in tests/unit/checkpoint/test_gpt2_import.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .deepspeed_checkpoint import DeepSpeedCheckpoint
+
+# Megatron layer-file param name -> (our path inside a block, transpose?)
+_BLOCK_MAP = {
+    "input_layernorm.weight": (("ln_1", "scale"), False),
+    "input_layernorm.bias": (("ln_1", "bias"), False),
+    "self_attention.query_key_value.weight": (("attn", "qkv", "kernel"), True),
+    "self_attention.query_key_value.bias": (("attn", "qkv", "bias"), False),
+    "self_attention.dense.weight": (("attn", "proj", "kernel"), True),
+    "self_attention.dense.bias": (("attn", "proj", "bias"), False),
+    # pre-SelfAttention-rename Megatron checkpoints
+    "attention.query_key_value.weight": (("attn", "qkv", "kernel"), True),
+    "attention.query_key_value.bias": (("attn", "qkv", "bias"), False),
+    "attention.dense.weight": (("attn", "proj", "kernel"), True),
+    "attention.dense.bias": (("attn", "proj", "bias"), False),
+    "post_attention_layernorm.weight": (("ln_2", "scale"), False),
+    "post_attention_layernorm.bias": (("ln_2", "bias"), False),
+    "mlp.dense_h_to_4h.weight": (("mlp", "fc", "kernel"), True),
+    "mlp.dense_h_to_4h.bias": (("mlp", "fc", "bias"), False),
+    "mlp.dense_4h_to_h.weight": (("mlp", "proj", "kernel"), True),
+    "mlp.dense_4h_to_h.bias": (("mlp", "proj", "bias"), False),
+}
+
+
+def _set(tree: Dict, path, value) -> None:
+    for p in path[:-1]:
+        tree = tree.setdefault(p, {})
+    tree[path[-1]] = value
+
+
+def megatron_gpt2_to_flax(ckpt_dir: str, config) -> Dict[str, Any]:
+    """Read a Megatron-DeepSpeed GPT-2 layer-file checkpoint (any original
+    TP/PP degree) and return the full (unsharded) flax param tree for
+    ``GPT2LMHeadModel(config)``. Shard it onto any mesh with
+    ``gpt2_sharding_rules`` / ``ds.initialize(model_parameters=...)``."""
+    ckpt = DeepSpeedCheckpoint(ckpt_dir, tp_degree=1, pp_degree=1)
+    params: Dict[str, Any] = {}
+
+    emb = ckpt.get_embedding_state(0)
+    wte = np.asarray(emb["word_embeddings.weight"])[:config.vocab_size]
+    _set(params, ("wte", "embedding"), wte)
+    if "position_embeddings.weight" in emb:
+        _set(params, ("wpe", "embedding"),
+             np.asarray(emb["position_embeddings.weight"])
+             [:config.n_positions])
+
+    norm = ckpt.get_final_norm_state(0)
+    _set(params, ("ln_f", "scale"), np.asarray(norm["weight"]))
+    if "bias" in norm:
+        _set(params, ("ln_f", "bias"), np.asarray(norm["bias"]))
+
+    # one merged state dict per transformer layer: the per-layer files are
+    # one-per-original-tp-rank; merge with the qkv/row/col concat rules
+    # (same path to_universal takes)
+    layers: List[Dict[str, Any]] = [
+        ckpt.merged_layer_state(layer_key)
+        for layer_key in ckpt.layer_keys[1:-1]]
+    assert len(layers) == config.n_layer, \
+        f"checkpoint has {len(layers)} transformer layers, config wants " \
+        f"{config.n_layer}"
+    stacked: Dict[tuple, List[np.ndarray]] = {}
+    for sd in layers:
+        for name, value in sd.items():
+            if name not in _BLOCK_MAP:
+                continue
+            path, transpose = _BLOCK_MAP[name]
+            arr = np.asarray(value)
+            if transpose:
+                arr = arr.T
+            stacked.setdefault(path, []).append(arr)
+    for path, arrs in stacked.items():
+        assert len(arrs) == config.n_layer, \
+            f"param {'/'.join(path)} present in only {len(arrs)} layers"
+        _set(params, ("blocks", "block") + path, np.stack(arrs))
+    return params

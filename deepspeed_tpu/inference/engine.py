@@ -227,12 +227,12 @@ class InferenceEngine:
         token = self._jit_sample(logits, sub, jnp.asarray(temperature, jnp.float32),
                                  int(top_k), float(top_p), greedy)
 
-        # The allocated KV capacity is the third-from-last dim of the cache
-        # k/v leaves — (B, capacity, KV, D), or (L, B, capacity, KV, D) when
+        # The allocated KV capacity is the second-from-last dim of the cache
+        # k/v leaves — (B, KV, capacity, D), or (L, B, KV, capacity, D) when
         # layers are nn.scan-stacked — authoritative even when the model
         # config lacks max_seq_len. Steps past capacity would write out of
         # bounds (silently clamped by JAX today, but fragile); fail loudly.
-        cache_cap = max((x.shape[-3] for x in jax.tree_util.tree_leaves(cache)
+        cache_cap = max((x.shape[-2] for x in jax.tree_util.tree_leaves(cache)
                          if getattr(x, "ndim", 0) >= 4), default=None)
         caps = [c for c in (max_len, cache_cap) if c is not None]
         capacity = min(caps) if caps else None

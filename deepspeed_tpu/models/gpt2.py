@@ -44,7 +44,9 @@ class GPT2Config:
     # outputs and recomputes only elementwise ops (cheaper recompute,
     # jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     remat_policy: str = "full"
-    use_flash_attention: bool = False  # Pallas kernel (TPU only)
+    # Pallas flash kernel: True | False | "auto" (on-TPU when seq >= the
+    # measured crossover — BASELINE.md; off elsewhere)
+    use_flash_attention: Any = "auto"
     # sequence/context parallelism over the `seq` mesh axis:
     # None | "ring" (ppermute KV rotation) | "ulysses" (all-to-all head swap)
     sequence_parallel: Optional[str] = None
@@ -96,6 +98,11 @@ class CausalSelfAttention(nn.Module):
         H = cfg.n_head
         qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        use_flash = cfg.use_flash_attention
+        if use_flash == "auto":
+            from ..ops.attention.flash_attention import use_flash_by_default
+
+            use_flash = use_flash_by_default(T) and cfg.dropout == 0
         q = q.reshape(B, T, H, C // H)
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
@@ -117,7 +124,7 @@ class CausalSelfAttention(nn.Module):
 
             head_axes = MODEL_AXIS if get_model_parallel_world_size() > 1 else None
             if cfg.sequence_parallel == "ring":
-                if cfg.use_flash_attention:
+                if cfg.use_flash_attention is True:
                     raise ValueError(
                         "sequence_parallel='ring' computes its own blockwise "
                         "softmax; use_flash_attention only composes with "
@@ -125,15 +132,15 @@ class CausalSelfAttention(nn.Module):
                 y = ring_attention(q, k, v, causal=True, head_axes=head_axes)
             else:
                 attn_fn = None
-                if cfg.use_flash_attention:
+                if use_flash:
                     from ..ops.attention.flash_attention import flash_attention
 
                     def attn_fn(q, k, v, *, causal, scale):
                         return flash_attention(q, k, v, causal=causal, scale=scale)
                 y = ulysses_attention(q, k, v, causal=True, head_axes=head_axes,
                                       attn_fn=attn_fn)
-        elif cfg.use_flash_attention:
-            if cfg.dropout > 0:
+        elif use_flash:
+            if cfg.dropout > 0 and cfg.use_flash_attention is True:
                 raise ValueError(
                     "use_flash_attention does not support attention-probability "
                     "dropout (dropout>0); use the dense path or dropout=0")

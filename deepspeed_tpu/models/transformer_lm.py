@@ -67,6 +67,7 @@ class TransformerConfig:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    decode_kernel: str = "auto"         # auto | on | off (fused Pallas decode)
 
     @property
     def head_dim(self) -> int:
@@ -174,6 +175,26 @@ class CachedAttention(nn.Module):
 
     config: TransformerConfig
 
+    def _use_decode_kernel(self, cache_len: int,
+                           deterministic: bool = True) -> bool:
+        """Route 1-token decode through the fused Pallas kernel. ``auto``:
+        on TPU with a kernel-compatible cache length; ``on`` forces it
+        (interpret mode off-TPU — for tests); ``off`` keeps the jnp path.
+        Attention-probability dropout (train-mode decode) has no kernel
+        equivalent — that combination stays on the jnp path."""
+        from ..ops.attention.decode_attention import pick_block_s
+
+        cfg = self.config
+        if cfg.decode_kernel == "off":
+            return False
+        if cfg.dropout > 0 and not deterministic:
+            return False
+        if pick_block_s(cache_len) < 8:
+            return False
+        if cfg.decode_kernel == "on":
+            return True
+        return jax.default_backend() == "tpu"
+
     @nn.compact
     def __call__(self, x, *, decode: bool = False, deterministic: bool = True):
         cfg = self.config
@@ -186,10 +207,13 @@ class CachedAttention(nn.Module):
         v = dense(KV * D, "v_proj")(x).reshape(B, T, KV, D)
 
         if decode:
+            # cache layout (B, KV, S, D): per-head (S, D) contiguous — the
+            # TPU-friendly layout the fused decode kernel requires (S on
+            # sublanes, D on lanes)
             ck = self.variable("cache", "k", jnp.zeros,
-                               (B, cfg.max_seq_len, KV, D), cfg.dtype)
+                               (B, KV, cfg.max_seq_len, D), cfg.dtype)
             cv = self.variable("cache", "v", jnp.zeros,
-                               (B, cfg.max_seq_len, KV, D), cfg.dtype)
+                               (B, KV, cfg.max_seq_len, D), cfg.dtype)
             cidx = self.variable("cache", "index",
                                  lambda: jnp.zeros((), jnp.int32))
             start = cidx.value
@@ -205,26 +229,45 @@ class CachedAttention(nn.Module):
 
         if decode:
             ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, start, 0, 0))
+                ck.value, k.astype(cfg.dtype).transpose(0, 2, 1, 3),
+                (0, 0, start, 0))
             cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, start, 0, 0))
+                cv.value, v.astype(cfg.dtype).transpose(0, 2, 1, 3),
+                (0, 0, start, 0))
             cidx.value = start + T
-            k_all, v_all = ck.value, cv.value
+            k_all, v_all = ck.value, cv.value  # (B, KV, S, D)
             S = cfg.max_seq_len
+            if T == 1 and self._use_decode_kernel(S, deterministic):
+                # fused Pallas decode attention (reference softmax_context,
+                # pt_binding.cpp:1910-1975): length masking + softmax +
+                # value reduction in one pass over the cache
+                from ..ops.attention.decode_attention import (
+                    decode_attention,
+                    pick_block_s,
+                )
+
+                slopes = alibi_slopes(H) if cfg.pos_emb == "alibi" else None
+                y = decode_attention(
+                    q[:, 0].astype(cfg.dtype), k_all, v_all, start + 1,
+                    alibi_slopes=slopes, block_s=pick_block_s(S))
+                y = y.astype(cfg.dtype).reshape(B, 1, H * D)
+                return nn.Dense(C, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
+                                name="o_proj")(y)
             # row t may see cache slots [0, start+t]
             mask = (jnp.arange(S)[None, :] <= (start + jnp.arange(T))[:, None])
         else:
-            k_all, v_all = k, v
+            k_all = k.transpose(0, 2, 1, 3)  # (B, KV, T, D)
+            v_all = v.transpose(0, 2, 1, 3)
             S = T
             mask = jnp.tril(jnp.ones((T, T), dtype=bool))
 
         if KV != H:
             rep = H // KV
-            k_all = jnp.repeat(k_all, rep, axis=2)
-            v_all = jnp.repeat(v_all, rep, axis=2)
+            k_all = jnp.repeat(k_all, rep, axis=1)
+            v_all = jnp.repeat(v_all, rep, axis=1)
 
         scale = 1.0 / math.sqrt(D)
-        att = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+        att = jnp.einsum("bthd,bhsd->bhts", q.astype(jnp.float32),
                          k_all.astype(jnp.float32)) * scale
         if cfg.pos_emb == "alibi":
             slopes = alibi_slopes(H)  # (H,)
@@ -235,7 +278,7 @@ class CachedAttention(nn.Module):
         att = jax.nn.softmax(att, axis=-1)
         if cfg.dropout > 0:
             att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
-        y = jnp.einsum("bhts,bshd->bthd", att,
+        y = jnp.einsum("bhts,bhsd->bthd", att,
                        v_all.astype(jnp.float32)).astype(cfg.dtype)
         y = y.reshape(B, T, H * D)
         return nn.Dense(C, use_bias=cfg.qkv_bias, dtype=cfg.dtype, name="o_proj")(y)

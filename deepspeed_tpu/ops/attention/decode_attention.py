@@ -1,0 +1,157 @@
+"""Fused KV-cache decode attention as a Pallas TPU kernel.
+
+TPU-native equivalent of the reference's generation hot path — the
+``softmax_context`` fused attention-with-KV-cache kernel
+(csrc/transformer/inference/csrc/pt_binding.cpp:1910-1975): one query
+token per sequence attends over the cache with length masking, softmax and
+the value reduction fused in a single pass. Decode is HBM-bandwidth bound
+(the whole cache is read every step); fusing keeps the (H, S) score matrix
+in VMEM instead of HBM and reads K/V exactly once.
+
+Layout: q (B, H, D); k/v cache (B, KV, S, D) — the model's cache layout
+(per-head (S, D) contiguous: S on sublanes, D on lanes, satisfying the
+Mosaic block-tiling rules). Grouped-query attention maps query head h to
+kv head h // (H // KV) in the BlockSpec index map. ``lengths`` (B,) masks
+cache slots >= length. Optional ALiBi slopes add the reference's alibi
+bias. Blocks past every sequence's length are skipped (dynamic
+``pl.when``), so cost tracks the LIVE cache length, not the allocated
+capacity.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import LANES, NEG_INF, SUBLANES, _interpret
+
+DEFAULT_BLOCK_S = 512
+
+
+def pick_block_s(cache_len: int, preferred: int = DEFAULT_BLOCK_S) -> int:
+    """Largest power-of-two block <= preferred that divides the cache
+    length (the kernel requires S % block_s == 0). Returns the largest
+    power-of-two divisor when that's below ``preferred``."""
+    block = preferred
+    while block > 1 and cache_len % block != 0:
+        block //= 2
+    return block
+
+
+def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale: float, block_s: int,
+                   alibi: bool):
+    # len_ref/slope_ref are scalar-prefetch SMEM arrays: (B,) and (H,)
+    j = pl.program_id(2)
+    num_s = pl.num_programs(2)
+    length = len_ref[pl.program_id(0)]
+    slope = slope_ref[pl.program_id(1)]
+    block_start = j * block_s
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(block_start < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # (1, D)
+        qb = jnp.broadcast_to(q, (SUBLANES, q.shape[-1]))
+        k = k_ref[0, 0].astype(jnp.float32)               # (block_s, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(qb, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = block_start + jax.lax.broadcasted_iota(
+            jnp.int32, (SUBLANES, block_s), 1)
+        if alibi:
+            # reference alibi bias: slope * (key_pos - query_pos); the
+            # decoding query sits at position length - 1
+            s = s + slope * (pos - (length - 1)).astype(jnp.float32)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == num_s - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:1, :1], 1e-30)
+        o_ref[0] = (acc_ref[:1] / l).astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     lengths: jax.Array, *, scale: Optional[float] = None,
+                     alibi_slopes: Optional[jax.Array] = None,
+                     block_s: int = DEFAULT_BLOCK_S) -> jax.Array:
+    """Single-token cached attention: softmax(q·K^T + bias) · V.
+
+    Args:
+      q: (B, H, D) current-step queries.
+      k_cache/v_cache: (B, KV, S, D) with H % KV == 0 (GQA).
+      lengths: (B,) or scalar int32 — valid cache slots per sequence
+        (INCLUDING the current token, already written to the cache).
+      alibi_slopes: optional (H,) ALiBi slopes.
+    Returns (B, H, D) in q's dtype.
+    """
+    B, H, D = q.shape
+    _, KV, S, _ = k_cache.shape
+    assert H % KV == 0, f"H={H} not a multiple of KV={KV}"
+    rep = H // KV
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (B,))
+    if alibi_slopes is None:
+        slopes = jnp.zeros((H,), jnp.float32)
+        alibi = False
+    else:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        alibi = True
+    block_s = min(block_s, S)
+    assert S % block_s == 0, f"cache length {S} % block_s {block_s} != 0"
+
+    grid = (B, H, S // block_s)
+    # q/out carry a dummy middle dim so every block's trailing two dims
+    # equal the array dims (the Mosaic tiling contract); lengths/slopes ride
+    # scalar prefetch (SMEM, fully resident) and index maps receive them as
+    # trailing args per the PrefetchScalarGridSpec contract
+    q3 = q.reshape(B * H, 1, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda b, h, j, *_: (b * H + h, 0, 0)),
+            pl.BlockSpec((1, 1, block_s, D),
+                         lambda b, h, j, *_: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_s, D),
+                         lambda b, h, j, *_: (b, h // rep, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D),
+                               lambda b, h, j, *_: (b * H + h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((SUBLANES, D), jnp.float32),
+            pltpu.VMEM((SUBLANES, LANES), jnp.float32),
+            pltpu.VMEM((SUBLANES, LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, block_s=block_s,
+                          alibi=alibi),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
+        interpret=_interpret(),
+    )(lengths, slopes, q3, k_cache, v_cache)
+    return out.reshape(B, H, D)

@@ -326,16 +326,53 @@ def _flash_attention_bwd(causal, scale, block_q, block_k, res, do):
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
+def auto_block_sizes(seq: int) -> "tuple[int, int]":
+    """(block_q, block_k) tuned on v5e (BASELINE.md crossover table):
+    bigger blocks amortize grid overhead; the best mix grows with seq.
+    Each block is shrunk (halved) until it divides ``seq`` — the kernel
+    requires exact tiling, and an odd seq must not crash the auto path."""
+    if seq >= 8192:
+        bq, bk = 1024, 1024
+    elif seq >= 4096:
+        bq, bk = 512, 1024
+    elif seq >= 2048:
+        bq, bk = 512, 512
+    else:
+        bq, bk = DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    while bq > 1 and seq % bq != 0:
+        bq //= 2
+    while bk > 1 and seq % bk != 0:
+        bk //= 2
+    return bq, bk
+
+
+def use_flash_by_default(seq: int) -> bool:
+    """Shape-based auto-selection: the Pallas kernel beats XLA's fused
+    attention from seq 2048 up on TPU (1.0x @2k, 2.0x @4k, 2.3x @8k —
+    BASELINE.md); below that XLA wins. Off-TPU (interpret mode) it is only
+    for tests. Shapes whose auto blocks would degenerate (seq with a tiny
+    power-of-two factor) stay on XLA."""
+    import jax
+
+    return jax.default_backend() == "tpu" and seq >= 2048 \
+        and min(auto_block_sizes(seq)) >= 128
+
+
 def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """Fused attention. q/k/v: (batch, seq, heads, head_dim) → same-shape out.
 
-    ``scale`` defaults to 1/sqrt(head_dim).
+    ``scale`` defaults to 1/sqrt(head_dim); block sizes default to the
+    seq-tuned table (``auto_block_sizes``).
     """
     b, t, h, d = q.shape
     _, s, _, _ = k.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    auto_q, auto_k = auto_block_sizes(max(t, s))
+    block_q = auto_q if block_q is None else block_q
+    block_k = auto_k if block_k is None else block_k
 
     # (B, T, H, D) → (B*H, T, D)
     def to_bh(x, T):

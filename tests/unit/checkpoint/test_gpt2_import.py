@@ -1,0 +1,205 @@
+"""Real-checkpoint GPT-2 migration: a reference-format (Megatron-DeepSpeed)
+checkpoint, TP-sharded with torch, imports into the flax GPT-2 and produces
+IDENTICAL logits whether read from tp=2 shards or the unsharded original —
+the VERDICT done-criterion for AutoTP/state-dict-factory validation
+(reference module_inject/auto_tp.py:13, runtime/state_dict_factory.py:190).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.checkpoint import megatron_gpt2_to_flax  # noqa: E402
+from deepspeed_tpu.models.gpt2 import (  # noqa: E402
+    GPT2Config,
+    GPT2LMHeadModel,
+    gpt2_sharding_rules,
+)
+
+HIDDEN, LAYERS, HEADS, VOCAB, POS = 16, 2, 2, 32, 16
+
+
+def _full_weights(seed=0):
+    """One set of full (unsharded) torch GPT-2 weights."""
+    g = torch.Generator().manual_seed(seed)
+    r = lambda *shape: torch.randn(*shape, generator=g) * 0.05  # noqa: E731
+    layers = []
+    for _ in range(LAYERS):
+        layers.append({
+            "input_layernorm.weight": torch.ones(HIDDEN),
+            "input_layernorm.bias": r(HIDDEN),
+            "self_attention.query_key_value.weight": r(3 * HIDDEN, HIDDEN),
+            "self_attention.query_key_value.bias": r(3 * HIDDEN),
+            "self_attention.dense.weight": r(HIDDEN, HIDDEN),
+            "self_attention.dense.bias": r(HIDDEN),
+            "post_attention_layernorm.weight": torch.ones(HIDDEN),
+            "post_attention_layernorm.bias": r(HIDDEN),
+            "mlp.dense_h_to_4h.weight": r(4 * HIDDEN, HIDDEN),
+            "mlp.dense_h_to_4h.bias": r(4 * HIDDEN),
+            "mlp.dense_4h_to_h.weight": r(HIDDEN, 4 * HIDDEN),
+            "mlp.dense_4h_to_h.bias": r(HIDDEN),
+        })
+    return {
+        "embedding": {"word_embeddings.weight": r(VOCAB, HIDDEN),
+                      "position_embeddings.weight": r(POS, HIDDEN)},
+        "layers": layers,
+        "final_norm": {"weight": torch.ones(HIDDEN), "bias": r(HIDDEN)},
+    }
+
+
+def _shard(full, tp):
+    """Megatron TP sharding conventions in torch (out, in) layout:
+    qkv & h_to_4h row-split (column-parallel), dense & 4h_to_h col-split
+    (row-parallel), embeddings vocab-split, norms replicated.
+
+    qkv uses the REAL version-0 Megatron layout: rank r's shard is
+    [q_r | k_r | v_r] fused — NOT a contiguous row chunk of the fused
+    matrix. A naive dim-0 merge scrambles this; the importer must regroup
+    per component (this is what makes the parity tests meaningful)."""
+    def rows(t):  # split dim 0
+        return torch.chunk(t, tp, dim=0)
+
+    def cols(t):  # split dim 1
+        return torch.chunk(t, tp, dim=1)
+
+    def qkv_shard(t, r):
+        q, k, v = torch.chunk(t, 3, dim=0)
+        return torch.cat([rows(q)[r], rows(k)[r], rows(v)[r]], dim=0)
+
+    shards = []
+    for r in range(tp):
+        layers = []
+        for layer in full["layers"]:
+            layers.append({
+                "input_layernorm.weight": layer["input_layernorm.weight"],
+                "input_layernorm.bias": layer["input_layernorm.bias"],
+                "self_attention.query_key_value.weight":
+                    qkv_shard(layer["self_attention.query_key_value.weight"],
+                              r),
+                "self_attention.query_key_value.bias":
+                    qkv_shard(layer["self_attention.query_key_value.bias"],
+                              r),
+                "self_attention.dense.weight":
+                    cols(layer["self_attention.dense.weight"])[r],
+                "self_attention.dense.bias": layer["self_attention.dense.bias"],
+                "post_attention_layernorm.weight":
+                    layer["post_attention_layernorm.weight"],
+                "post_attention_layernorm.bias":
+                    layer["post_attention_layernorm.bias"],
+                "mlp.dense_h_to_4h.weight":
+                    rows(layer["mlp.dense_h_to_4h.weight"])[r],
+                "mlp.dense_h_to_4h.bias":
+                    rows(layer["mlp.dense_h_to_4h.bias"])[r],
+                "mlp.dense_4h_to_h.weight":
+                    cols(layer["mlp.dense_4h_to_h.weight"])[r],
+                "mlp.dense_4h_to_h.bias": layer["mlp.dense_4h_to_h.bias"],
+            })
+        shards.append({
+            "embedding": {
+                "word_embeddings.weight":
+                    rows(full["embedding"]["word_embeddings.weight"])[r],
+                "position_embeddings.weight":
+                    full["embedding"]["position_embeddings.weight"],
+            },
+            "layers": layers,
+            "final_norm": dict(full["final_norm"]),
+        })
+    return shards
+
+
+def _write_ckpt(dirpath, shards):
+    """Reference layer-file layout: layer_00 embedding, 01..L transformer,
+    L+1 final norm; one file per tp rank + mp_rank state files."""
+    dirpath.mkdir(parents=True, exist_ok=True)
+    tp = len(shards)
+    last = LAYERS + 1
+    for r, shard in enumerate(shards):
+        torch.save(shard["embedding"],
+                   dirpath / f"layer_00-model_{r:02d}-model_states.pt")
+        for i, layer in enumerate(shard["layers"]):
+            torch.save(layer,
+                       dirpath / f"layer_{i + 1:02d}-model_{r:02d}"
+                       f"-model_states.pt")
+        torch.save(shard["final_norm"],
+                   dirpath / f"layer_{last:02d}-model_{r:02d}"
+                   f"-model_states.pt")
+        torch.save({"iteration": 7},
+                   dirpath / f"mp_rank_{r:02d}_model_states.pt")
+    return dirpath
+
+
+@pytest.fixture
+def cfg():
+    return GPT2Config(vocab_size=VOCAB, n_positions=POS, n_embd=HIDDEN,
+                      n_layer=LAYERS, n_head=HEADS, dtype=jnp.float32)
+
+
+def _logits(cfg, params, ids):
+    model = GPT2LMHeadModel(cfg)
+    return np.asarray(model.apply({"params": params}, ids,
+                                  method=GPT2LMHeadModel.logits))
+
+
+def test_tp2_shards_match_unsharded_logits(tmp_path, cfg):
+    full = _full_weights()
+    d1 = _write_ckpt(tmp_path / "tp1", _shard(full, 1))
+    d2 = _write_ckpt(tmp_path / "tp2", _shard(full, 2))
+
+    p1 = megatron_gpt2_to_flax(str(d1), cfg)
+    p2 = megatron_gpt2_to_flax(str(d2), cfg)
+
+    # the merge reconstructed every weight exactly
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), p1, p2)
+
+    ids = np.arange(8, dtype=np.int32)[None] % VOCAB
+    np.testing.assert_allclose(_logits(cfg, p2, ids), _logits(cfg, p1, ids),
+                               rtol=1e-6)
+
+
+def test_imported_tree_matches_model_structure(tmp_path, cfg):
+    d = _write_ckpt(tmp_path / "tp2", _shard(_full_weights(), 2))
+    params = megatron_gpt2_to_flax(str(d), cfg)
+    model = GPT2LMHeadModel(cfg)
+    init = model.init({"params": jax.random.PRNGKey(0),
+                       "dropout": jax.random.PRNGKey(0)},
+                      {"input_ids": np.zeros((1, 4), np.int32)})["params"]
+    init_paths = {jax.tree_util.keystr(kp): np.shape(leaf) for kp, leaf
+                  in jax.tree_util.tree_leaves_with_path(init)}
+    got_paths = {jax.tree_util.keystr(kp): np.shape(leaf) for kp, leaf
+                 in jax.tree_util.tree_leaves_with_path(params)}
+    assert got_paths == init_paths
+
+
+def test_imported_params_run_sharded_tp2(tmp_path, cfg):
+    """The migrated checkpoint actually trains/infers under tp=2: logits of
+    the tp-sharded engine equal the unsharded apply."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import initialize_mesh, reset_mesh
+    from deepspeed_tpu.runtime.zero.policy import ShardingRules
+
+    d = _write_ckpt(tmp_path / "tp2", _shard(_full_weights(), 2))
+    params = megatron_gpt2_to_flax(str(d), cfg)
+    # batch rows divisible by dp=4
+    ids = (np.arange(32, dtype=np.int32) % VOCAB).reshape(4, 8)
+    expect = _logits(cfg, params, ids)
+
+    reset_mesh()
+    initialize_mesh(data=4, model=2)
+    eng, _, _, _ = ds.initialize(
+        model=GPT2LMHeadModel(cfg), model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "zero_optimization": {"stage": 0},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        sharding_rules=ShardingRules(gpt2_sharding_rules()))
+    loss = eng.forward({"input_ids": ids})
+    assert np.isfinite(float(loss))
+    sharded_logits = np.asarray(jax.device_get(jax.jit(
+        lambda p, i: eng.module.apply({"params": p}, i,
+                                      method=GPT2LMHeadModel.logits))(
+            eng.state["params"], ids)))
+    np.testing.assert_allclose(sharded_logits, expect, atol=2e-5, rtol=1e-4)
