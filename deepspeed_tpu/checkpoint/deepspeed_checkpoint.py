@@ -277,7 +277,7 @@ class DeepSpeedCheckpoint:
 
         loader = MegatronSDLoader([], version=ckpt_version)
         for key in merged:
-            if "query_key_value" in key:
+            if MegatronSDLoader._is_qkv(key):
                 merged[key] = loader.merge_query_key_value(
                     [np.asarray(sd[key]) for sd in sds], dim=0)
         return merged

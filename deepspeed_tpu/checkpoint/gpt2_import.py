@@ -62,6 +62,13 @@ def megatron_gpt2_to_flax(ckpt_dir: str, config) -> Dict[str, Any]:
     ``GPT2LMHeadModel(config)``. Shard it onto any mesh with
     ``gpt2_sharding_rules`` / ``ds.initialize(model_parameters=...)``."""
     ckpt = DeepSpeedCheckpoint(ckpt_dir, tp_degree=1, pp_degree=1)
+    version = ckpt.checkpoint_version()
+    if version >= 1.0:
+        raise NotImplementedError(
+            f"checkpoint_version {version}: versions >= 1.0 store qkv "
+            f"per-head-interleaved, which does not match this model's "
+            f"contiguous [q|k|v] split — re-layout support is not "
+            f"implemented; convert with Megatron's own tools first")
     params: Dict[str, Any] = {}
 
     emb = ckpt.get_embedding_state(0)

@@ -93,7 +93,9 @@ class InferenceEngine:
                 {"params": rng},
                 jnp.asarray(input_ids[:1]), method=self.module.logits)
             self._params_host = variables["params"]
+        self._finalize_params()
 
+    def _finalize_params(self) -> None:
         def cast(p):
             p = jnp.asarray(p)
             return p.astype(self.dtype) if jnp.issubdtype(p.dtype, jnp.floating) \
@@ -112,7 +114,8 @@ class InferenceEngine:
 
         self._param_shardings = jax.tree_util.tree_map_with_path(leaf_sharding, params)
         self.params = jax.device_put(params, self._param_shardings)
-        self._build_jits()
+        if hasattr(self.module, "logits"):
+            self._build_jits()
 
     def _build_jits(self) -> None:
         module = self.module
@@ -181,13 +184,40 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, *args, **kwargs):
-        """Full-context logits (≅ reference engine.forward,
-        inference/engine.py:592)."""
+        """Full-context logits for LM modules; non-LM modules (no
+        ``logits`` method — e.g. the diffusion family) run a generic
+        compiled apply over the given arguments (≅ reference
+        engine.forward, inference/engine.py:592, which serves any wrapped
+        module)."""
+        if not hasattr(self.module, "logits"):
+            return self._generic_forward(input_ids, *args, **kwargs)
         input_ids = jnp.asarray(input_ids)
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
         self._ensure_params(input_ids)
         return self._jit_logits(self.params, input_ids)
+
+    def _generic_forward(self, *args, **kwargs):
+        args = tuple(jnp.asarray(a) for a in args)
+        if self.params is None:
+            if self._params_host is None:
+                if not hasattr(self.module, "init"):
+                    raise ValueError(
+                        "pass model_parameters= for non-flax models")
+                self._params_host = self.module.init(
+                    {"params": jax.random.PRNGKey(0)}, *args,
+                    **kwargs)["params"]
+            self._finalize_params()
+        # kwargs are threaded into the compiled apply (keys are static; a
+        # new key set recompiles)
+        kw_keys = tuple(sorted(kwargs))
+        if getattr(self, "_jit_generic_keys", None) != kw_keys:
+            self._jit_generic_keys = kw_keys
+            self._jit_generic = jax.jit(
+                lambda p, a, kv: self.module.apply(
+                    {"params": p}, *a, **dict(zip(kw_keys, kv))))
+        return self._jit_generic(self.params, args,
+                                 tuple(kwargs[k] for k in kw_keys))
 
     __call__ = forward
 

@@ -1,0 +1,31 @@
+"""Spatial (diffusers) fused bias ops.
+
+Capability parity with reference ``csrc/spatial/csrc/opt_bias_add.cu`` +
+``pt_binding.cpp:109-111`` (``nhwc_bias_add``, ``nhwc_bias_add_add``,
+``nhwc_bias_add_bias_add``) — the UNet/VAE hot elementwise ops. On TPU
+these are jnp expressions: XLA fuses them into the surrounding convs (the
+fusion the reference does by hand in CUDA), so the parity surface is the
+op vocabulary + NHWC layout contract, not a custom kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def nhwc_bias_add(activation: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    """activation (N, H, W, C) + bias (C,)."""
+    return activation + bias.reshape((1,) * (activation.ndim - 1) + (-1,))
+
+
+def nhwc_bias_add_add(activation: jnp.ndarray, bias: jnp.ndarray,
+                      other: jnp.ndarray) -> jnp.ndarray:
+    """(activation + bias) + other — the residual-add variant."""
+    return nhwc_bias_add(activation, bias) + other
+
+
+def nhwc_bias_add_bias_add(activation: jnp.ndarray, bias: jnp.ndarray,
+                           other: jnp.ndarray,
+                           other_bias: jnp.ndarray) -> jnp.ndarray:
+    """(activation + bias) + (other + other_bias) — two biased branches."""
+    return nhwc_bias_add(activation, bias) + nhwc_bias_add(other, other_bias)
