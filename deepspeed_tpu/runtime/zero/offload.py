@@ -118,19 +118,27 @@ class OffloadedOptimizer:
         safe = re.sub(r"[^A-Za-z0-9_.-]", "_", p)
         return os.path.join(self.nvme_dir, f"{safe}.{kind}.bin")
 
-    def _swap_out_all(self) -> None:
-        for p in list(self.m):
-            if not self._float[p] or self.m[p] is None:
-                continue
-            self._aio.async_pwrite(self.m[p], self._leaf_file(p, "m"))
-            self._aio.async_pwrite(self.v[p], self._leaf_file(p, "v"))
-            self._aio.async_pwrite(self.master[p].ravel(),
-                                   self._leaf_file(p, "master"))
-        self._aio.wait()
+    def _submit_leaf_swap_out(self, p: str) -> None:
+        """Queue one leaf's m/v/master writes (layout: moments raveled 1-D,
+        master raveled from its shape). Caller drains with _aio.wait()."""
+        self._aio.async_pwrite(self.m[p], self._leaf_file(p, "m"))
+        self._aio.async_pwrite(self.v[p], self._leaf_file(p, "v"))
+        self._aio.async_pwrite(self.master[p].ravel(),
+                               self._leaf_file(p, "master"))
+
+    def _drop_stores(self) -> None:
         for p in self.m:
             if self._float[p]:
                 self.m[p] = self.v[p] = None
                 self.master[p] = None
+
+    def _swap_out_all(self) -> None:
+        for p in list(self.m):
+            if not self._float[p] or self.m[p] is None:
+                continue
+            self._submit_leaf_swap_out(p)
+        self._aio.wait()
+        self._drop_stores()
 
     def _swap_in_all(self) -> None:
         for p, shape in self._shapes.items():
@@ -184,31 +192,60 @@ class OffloadedOptimizer:
     def step(self, grads_host, lr: float, step_num: int, compute_dtype):
         """Apply one host Adam step. ``grads_host``: pytree of fp32 numpy
         (already unscaled/clipped). Returns the new compute-dtype param
-        pytree (host arrays, ready for device_put). ``step_num`` 1-indexed."""
+        pytree (host arrays, ready for device_put). ``step_num`` 1-indexed.
+
+        NVMe tier pipelining (≅ PipelinedOptimizerSwapper): reads for all
+        leaves are submitted up front and overlap each other across the
+        AIO thread pool; each leaf's swap-OUT writes are submitted the
+        moment its Adam update finishes, so writes overlap the remaining
+        leaves' compute, with one drain at the end. ``last_timings``
+        records the phase breakdown {swap_in_s, compute_s, drain_s}."""
+        import time
+
         import ml_dtypes
 
+        t0 = time.perf_counter()
         if self.nvme:
             self._swap_in_all()
+        t_in = time.perf_counter()
         grads = _flatten_with_paths(grads_host)
         out: Dict[str, np.ndarray] = {}
         to_bf16 = compute_dtype is not None and \
             np.dtype(compute_dtype) == np.dtype(ml_dtypes.bfloat16)
-        for p, master in self.master.items():
-            if not self._float[p]:
-                out[p] = master
-                continue
-            g = np.ascontiguousarray(np.asarray(grads[p], np.float32)).ravel()
-            self.opt.step(master.reshape(-1) if master.shape else master.ravel(),
-                          g, self.m[p], self.v[p], step_num, lr=lr)
-            if compute_dtype is None or master.dtype == np.dtype(compute_dtype):
-                out[p] = master.copy()
-            elif to_bf16:
-                out[p] = self.opt.to_bf16(master.reshape(-1)).reshape(
-                    self._shapes[p])
-            else:
-                out[p] = master.astype(compute_dtype)
-        if self.nvme:
-            self._swap_out_all()
+        try:
+            for p, master in self.master.items():
+                if not self._float[p]:
+                    out[p] = master
+                    continue
+                g = np.ascontiguousarray(
+                    np.asarray(grads[p], np.float32)).ravel()
+                self.opt.step(
+                    master.reshape(-1) if master.shape else master.ravel(),
+                    g, self.m[p], self.v[p], step_num, lr=lr)
+                if compute_dtype is None or \
+                        master.dtype == np.dtype(compute_dtype):
+                    out[p] = master.copy()
+                elif to_bf16:
+                    out[p] = self.opt.to_bf16(master.reshape(-1)).reshape(
+                        self._shapes[p])
+                else:
+                    out[p] = master.astype(compute_dtype)
+                if self.nvme:
+                    # submit this leaf's swap-out NOW — the write overlaps
+                    # the next leaves' Adam compute (the handle keeps the
+                    # buffers alive until the drain)
+                    self._submit_leaf_swap_out(p)
+            t_compute = time.perf_counter()
+        finally:
+            # an exception mid-loop must still drain in-flight writes, or a
+            # later _swap_in_all could read partially-written files
+            if self.nvme:
+                self._aio.wait()
+                self._drop_stores()
+        t_drain = time.perf_counter()
+        self.last_timings = {"swap_in_s": t_in - t0,
+                             "compute_s": t_compute - t_in,
+                             "drain_s": t_drain - t_compute}
         return _unflatten_like(self._template, out)
 
     def sync_master_from(self, params_host) -> None:

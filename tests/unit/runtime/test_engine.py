@@ -172,3 +172,64 @@ def test_dataloader_path():
     it = iter(loader)
     loss = engine.train_batch(data_iter=it)
     assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# round 2: eager-path convergence parity vs the fused path (VERDICT weak #9)
+# ---------------------------------------------------------------------------
+def _eager_steps(engine, batches):
+    """Drive forward/backward/step over the same micro order train_batch
+    uses (contiguous reshape: micro i = rows [i*m:(i+1)*m])."""
+    losses = []
+    gas = engine.gradient_accumulation_steps()
+    for batch in batches:
+        micro_rows = batch["x"].shape[0] // gas
+        acc = 0.0
+        for i in range(gas):
+            micro = {k: v[i * micro_rows:(i + 1) * micro_rows]
+                     for k, v in batch.items()}
+            loss = engine.forward(micro)
+            engine.backward(loss)
+            acc += float(loss)
+            engine.step()
+        losses.append(acc / gas)
+    return losses
+
+
+@pytest.mark.parametrize("stage", [0, 2, 3])
+def test_eager_matches_fused_trajectory(stage):
+    """Multi-step, gas=2, per-ZeRO-stage: the eager triple must follow the
+    fused train_batch trajectory (params AND losses)."""
+    import jax
+
+    e1 = _make_engine(stage=stage, micro=2, gas=2)
+    e2 = _make_engine(stage=stage, micro=2, gas=2)
+    batches = [random_batch(e1.train_batch_size(), seed=50 + i)
+               for i in range(3)]
+    fused = [float(e1.train_batch(batch=b)) for b in batches]
+    eager = _eager_steps(e2, batches)
+    np.testing.assert_allclose(eager, fused, rtol=1e-4, atol=1e-5)
+    assert e1.global_steps == e2.global_steps == 3
+    for a, b in zip(jax.tree_util.tree_leaves(e1.state["params"]),
+                    jax.tree_util.tree_leaves(e2.state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_eager_matches_fused_fp16_loss_scaling():
+    """The dynamic loss-scale state must evolve identically on both paths
+    (scale halving on overflow, growth on the window)."""
+    import jax
+
+    extra = {"fp16": {"enabled": True, "initial_scale_power": 10,
+                      "loss_scale_window": 2}}
+    e1 = _make_engine(dtype="fp16", micro=2, gas=2, extra=extra)
+    e2 = _make_engine(dtype="fp16", micro=2, gas=2, extra=extra)
+    batches = [random_batch(e1.train_batch_size(), seed=80 + i)
+               for i in range(4)]
+    fused = [float(e1.train_batch(batch=b)) for b in batches]
+    eager = _eager_steps(e2, batches)
+    np.testing.assert_allclose(eager, fused, rtol=2e-3, atol=2e-3)
+    s1 = float(np.asarray(e1.state["scale"].loss_scale))
+    s2 = float(np.asarray(e2.state["scale"].loss_scale))
+    assert s1 == s2, (s1, s2)
