@@ -90,11 +90,28 @@ def _torch_load(path: str) -> Dict[str, Any]:
     except TypeError:
         # torch < 1.13: no weights_only kwarg — plain load, as before
         return torch.load(path, map_location="cpu")
-    except pickle.UnpicklingError as e:
+    except (pickle.UnpicklingError, RuntimeError) as e:
+        # torch raises UnpicklingError on some versions, RuntimeError on
+        # others, for weights_only failures (OSError/FileNotFoundError pass
+        # through unchanged); the unsafe fallback requires explicit opt-in.
+        # Unrelated RuntimeErrors (e.g. a truncated zip) propagate as-is —
+        # retrying them unsafely is futile and the opt-in hint misleading.
+        msg = str(e)
+        if isinstance(e, RuntimeError) and \
+                "Weights only load failed" not in msg and \
+                "Unsupported global" not in msg and \
+                "weights_only" not in msg:
+            raise
+        if os.environ.get("DS_TRUST_CHECKPOINT") != "1":
+            raise RuntimeError(
+                f"{path} failed the weights_only safe load ({e}). Full "
+                "unpickling EXECUTES code embedded in the checkpoint; if you "
+                "trust this file, set DS_TRUST_CHECKPOINT=1 to allow it."
+            ) from e
         logger.warning(
-            "%s failed the weights_only safe load (%s); falling back to full "
-            "unpickling, which EXECUTES code embedded in the checkpoint. Only "
-            "proceed with checkpoints from a trusted source.", path, e)
+            "%s failed the weights_only safe load (%s); DS_TRUST_CHECKPOINT=1 "
+            "set — falling back to full unpickling, which EXECUTES code "
+            "embedded in the checkpoint.", path, e)
         return torch.load(path, map_location="cpu", weights_only=False)
 
 

@@ -275,7 +275,8 @@ class InferenceEngine:
             self._jit_generic_keys = kw_keys
             self._jit_generic = jax.jit(
                 lambda p, a, kv: self.module.apply(
-                    {"params": p}, *a, **dict(zip(kw_keys, kv))))
+                    {"params": self._dequant(p)}, *a,
+                    **dict(zip(kw_keys, kv))))
         return self._jit_generic(self.params, args,
                                  tuple(kwargs[k] for k in kw_keys))
 

@@ -13,9 +13,11 @@ Layout: q (B, H, D); k/v cache (B, KV, S, D) — the model's cache layout
 Mosaic block-tiling rules). Grouped-query attention maps query head h to
 kv head h // (H // KV) in the BlockSpec index map. ``lengths`` (B,) masks
 cache slots >= length. Optional ALiBi slopes add the reference's alibi
-bias. Blocks past every sequence's length are skipped (dynamic
-``pl.when``), so cost tracks the LIVE cache length, not the allocated
-capacity.
+bias. Blocks past every sequence's length skip the COMPUTE (dynamic
+``pl.when``) — but the BlockSpec still DMAs those K/V blocks into VMEM,
+so HBM traffic scales with the grid's S extent, not the live length.
+Bounding the bandwidth cost requires the caller to pass a cache view
+sliced to (a multiple of ``block_s`` covering) the max live length.
 """
 
 from __future__ import annotations

@@ -368,9 +368,20 @@ def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
     """
     b, t, h, d = q.shape
     _, s, _, _ = k.shape
+    if causal and t != s:
+        raise ValueError(
+            f"causal flash attention requires seq_q == seq_k (got {t} vs {s});"
+            " the mask assumes aligned positions. Use causal=False for"
+            " cross-attention.")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    auto_q, auto_k = auto_block_sizes(max(t, s))
+    # Derive block_q from t and block_k from s independently — the kernel
+    # requires t % block_q == 0 and s % block_k == 0, and t != s (non-causal
+    # cross-attention; causal masking assumes aligned q/k positions, so
+    # causal t != s is not supported) would otherwise pick blocks tuned for
+    # one length that fail to divide the other.
+    auto_q, _ = auto_block_sizes(t)
+    _, auto_k = auto_block_sizes(s)
     block_q = auto_q if block_q is None else block_q
     block_k = auto_k if block_k is None else block_k
 

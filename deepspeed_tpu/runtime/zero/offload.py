@@ -25,7 +25,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
-from ...utils.logging import log_dist
+from ...utils.logging import log_dist, logger
 from .offload_config import DeepSpeedZeroOffloadOptimizerConfig, OffloadDeviceEnum
 
 
@@ -144,6 +144,11 @@ class OffloadedOptimizer:
         for p, shape in self._shapes.items():
             if not self._float[p]:
                 continue
+            if self.m[p] is not None:
+                # in-memory copy still live (e.g. a prior swap-out drain
+                # failed and the files may be partial) — it is authoritative;
+                # reading the file would clobber good state with garbage
+                continue
             n = int(np.prod(shape)) if shape else 1
             self.m[p] = np.empty(n, np.float32)
             self.v[p] = np.empty(n, np.float32)
@@ -236,11 +241,27 @@ class OffloadedOptimizer:
                     # buffers alive until the drain)
                     self._submit_leaf_swap_out(p)
             t_compute = time.perf_counter()
-        finally:
+        except BaseException:
             # an exception mid-loop must still drain in-flight writes, or a
-            # later _swap_in_all could read partially-written files
+            # later _swap_in_all could read partially-written files. Drain
+            # non-raising here: an IOError raised inside cleanup would
+            # REPLACE the original in-flight exception (the root cause).
             if self.nvme:
-                self._aio.wait()
+                try:
+                    self._aio.wait()
+                except IOError as io_err:
+                    # a failed drain means the on-disk leaf files may be
+                    # partially written — keep the in-memory copies (do NOT
+                    # _drop_stores) so they stay authoritative for retry
+                    logger.warning("swap-out drain failed during exception "
+                                   "unwind: %s — keeping in-memory optimizer "
+                                   "state authoritative", io_err)
+                else:
+                    self._drop_stores()
+            raise
+        else:
+            if self.nvme:
+                self._aio.wait()  # raises on any failed chunk
                 self._drop_stores()
         t_drain = time.perf_counter()
         self.last_timings = {"swap_in_s": t_in - t0,
