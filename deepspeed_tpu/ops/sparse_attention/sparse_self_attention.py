@@ -117,20 +117,31 @@ class SparseSelfAttention:
     """≅ reference ``SparseSelfAttention`` (sparse_self_attention.py:12):
     callable taking (q, k, v) shaped (B, T, H, D) and applying the configured
     block-sparse pattern. Layouts are built once per sequence length and
-    cached (static under jit)."""
+    cached (static under jit).
+
+    ``kernel``: "auto" routes to the fused Pallas splash-style kernel
+    (``pallas_kernel.py``) when the layout granule is MXU-sized
+    (block >= 128) and no key-padding mask is given, else the gather
+    formulation; "pallas"/"gather" force a path.
+    """
 
     def __init__(self, sparsity_config: SparsityConfig = None,
                  key_padding_mask_mode: str = "add",
-                 attn_mask_mode: str = "mul"):
+                 attn_mask_mode: str = "mul",
+                 kernel: str = "auto"):
         if key_padding_mask_mode not in ("add", "mul"):
             raise ValueError(f"key_padding_mask_mode must be add|mul, got "
                              f"{key_padding_mask_mode!r}")
         if attn_mask_mode not in ("add", "mul"):
             raise ValueError(f"attn_mask_mode must be add|mul, got "
                              f"{attn_mask_mode!r}")
+        if kernel not in ("auto", "pallas", "gather"):
+            raise ValueError(f"kernel must be auto|pallas|gather, got "
+                             f"{kernel!r}")
         self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
         self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
+        self.kernel = kernel
         self._layouts = {}
 
     def get_layout(self, seq_len: int) -> np.ndarray:
@@ -147,6 +158,7 @@ class SparseSelfAttention:
         cfg = self.sparsity_config
         T = query.shape[1]
         layout = self.get_layout(T)
+        causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
         keep = None
         if key_padding_mask is not None:
             # "add": additive float mask (0 keep, large-negative drop);
@@ -155,9 +167,20 @@ class SparseSelfAttention:
                 keep = key_padding_mask > -1.0
             else:
                 keep = key_padding_mask > 0
+
+        from .pallas_kernel import block_sparse_flash_attention, supports_pallas
+        use_pallas = self.kernel == "pallas" or (
+            self.kernel == "auto" and keep is None
+            and supports_pallas(cfg.block, T))
+        if use_pallas:
+            if keep is not None:
+                raise NotImplementedError(
+                    "key_padding_mask is not supported by the Pallas "
+                    "block-sparse kernel; use kernel=\"gather\"")
+            return block_sparse_flash_attention(
+                query, key, value, layout, cfg.block, causal=causal)
         return block_sparse_attention(
-            query, key, value, layout, cfg.block,
-            causal=getattr(cfg, "attention", "bidirectional") == "unidirectional",
+            query, key, value, layout, cfg.block, causal=causal,
             key_padding_mask=keep)
 
 

@@ -1,0 +1,143 @@
+"""Pallas block-sparse flash attention vs the gather formulation.
+
+The gather path (``sparse_self_attention.block_sparse_attention``) is the
+numerics reference (itself tested against dense attention in
+test_sparse_attention.py); these tests pin the fused kernel to it fwd+bwd
+across the sparsity-config vocabulary, plus the routing rules.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention.pallas_kernel import (
+    MIN_KERNEL_BLOCK,
+    block_sparse_flash_attention,
+    layout_to_schedule,
+    supports_pallas,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention,
+    block_sparse_attention,
+)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig,
+    FixedSparsityConfig,
+)
+
+BLOCK = 128
+
+
+def _qkv(rng, B=1, T=512, H=2, D=64):
+    mk = lambda: jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _local_global_layout(H, nq):
+    layout = np.zeros((H, nq, nq), np.int32)
+    for h in range(H):
+        for i in range(nq):
+            layout[h, i, i] = 1
+            if i > 0:
+                layout[h, i, i - 1] = 1
+            layout[h, i, 0] = 1
+    return layout
+
+
+def test_layout_to_schedule_padding_repeats_last():
+    layout = np.zeros((1, 3, 4), np.int32)
+    layout[0, 0, [1, 3]] = 1
+    layout[0, 1, 2] = 1
+    # row 2 empty
+    idx, cnt = layout_to_schedule(layout)
+    assert idx.shape == (1, 3, 2)
+    assert cnt.tolist() == [[2, 1, 0]]
+    assert idx[0, 0].tolist() == [1, 3]
+    assert idx[0, 1].tolist() == [2, 2]   # padded with last live index
+    assert idx[0, 2].tolist() == [0, 0]   # empty row points at block 0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fwd_bwd_matches_gather(causal):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng)
+    layout = _local_global_layout(2, q.shape[1] // BLOCK)
+
+    ref = block_sparse_attention(q, k, v, layout, BLOCK, causal=causal)
+    out = block_sparse_flash_attention(q, k, v, layout, BLOCK, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a, layout, BLOCK, causal=causal) ** 2)
+
+    g_ref = jax.grad(loss(block_sparse_attention), argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss(block_sparse_flash_attention),
+                     argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4)
+
+
+def test_empty_rows_produce_zero_output():
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, T=256)
+    layout = np.zeros((2, 2, 2), np.int32)
+    layout[:, 0, 0] = 1  # q-block 1 attends nothing
+    out = block_sparse_flash_attention(q, k, v, layout, BLOCK, causal=False)
+    np.testing.assert_allclose(np.asarray(out[:, BLOCK:]), 0.0, atol=1e-6)
+
+
+def test_different_layout_per_head():
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, T=512)
+    nq = 4
+    layout = _local_global_layout(2, nq)
+    layout[1] = np.eye(nq, dtype=np.int32)  # head 1: diagonal only
+    ref = block_sparse_attention(q, k, v, layout, BLOCK, causal=False)
+    out = block_sparse_flash_attention(q, k, v, layout, BLOCK, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+@pytest.mark.parametrize("cfg_cls,kwargs", [
+    (FixedSparsityConfig, dict(num_local_blocks=2, num_global_blocks=1,
+                               attention="unidirectional")),
+    (BigBirdSparsityConfig, dict(num_random_blocks=1, num_sliding_window_blocks=2,
+                                 num_global_blocks=1)),
+])
+def test_sparsity_config_vocabulary(cfg_cls, kwargs):
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, T=512)
+    cfg = cfg_cls(num_heads=2, block=BLOCK, **kwargs)
+    layout = cfg.make_layout(q.shape[1])
+    causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
+    ref = block_sparse_attention(q, k, v, layout, BLOCK, causal=causal)
+    out = block_sparse_flash_attention(q, k, v, layout, BLOCK, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_module_routes_to_pallas_for_coarse_blocks():
+    rng = np.random.default_rng(4)
+    q, k, v = _qkv(rng, T=512)
+    cfg = FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=2,
+                              num_global_blocks=1)
+    auto = SparseSelfAttention(cfg)(q, k, v)
+    gather = SparseSelfAttention(cfg, kernel="gather")(q, k, v)
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(gather), atol=1e-4)
+
+
+def test_module_falls_back_for_fine_blocks():
+    rng = np.random.default_rng(5)
+    q, k, v = _qkv(rng, T=128)
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    out = SparseSelfAttention(cfg)(q, k, v)  # auto → gather, no error
+    assert out.shape == q.shape
+    assert not supports_pallas(16, 128)
+    with pytest.raises(ValueError):
+        block_sparse_flash_attention(q, k, v, cfg.make_layout(128), 16)
+
+
+def test_supports_pallas_rules():
+    assert supports_pallas(MIN_KERNEL_BLOCK, 512)
+    assert not supports_pallas(64, 512)       # sub-MXU granule
+    assert not supports_pallas(MIN_KERNEL_BLOCK, 500)  # non-divisible seq
