@@ -20,6 +20,8 @@ from .accelerator import get_accelerator  # noqa: F401
 from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .runtime.engine import DeepSpeedEngine  # noqa: F401
 from .utils.logging import log_dist, logger  # noqa: F401
+from .comm.comm import init_distributed  # noqa: F401  (≅ reference
+# deepspeed.init_distributed, deepspeed/__init__.py:303 re-export)
 
 
 def initialize(args=None,

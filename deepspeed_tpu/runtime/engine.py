@@ -154,6 +154,13 @@ class DeepSpeedEngine:
         opt_cfg = self._config.optimizer
         self.optimizer_def: OptimizerDef = get_optimizer(
             opt_cfg.type if opt_cfg else "adam", opt_cfg.params if opt_cfg else {})
+        # 1-bit compressed exchange (fp16/onebit/wire.py): validated up
+        # front so misconfigurations fail at initialize(), not first step
+        from .fp16.onebit import wire as onebit_wire
+        self._onebit_wire = (not self._offload_enabled
+                             and onebit_wire.is_enabled(self._config, self.mesh))
+        if self._onebit_wire:
+            onebit_wire.check_supported(self)
         self._base_lr = float((opt_cfg.params if opt_cfg else {}).get("lr", 1e-3))
         sched_cfg = self._config.scheduler
         if lr_scheduler is not None:
@@ -346,8 +353,15 @@ class DeepSpeedEngine:
         # smallest batch-world-divisible slice (shard_map'd models — e.g.
         # sequence-parallel attention — require divisible shapes even at init)
         n = self.dp_world_size
-        micro = jax.tree_util.tree_map(
-            lambda x: np.asarray(x[:min(len(x), n)]), batch)
+
+        def host_slice(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # multi-process: fetch this process's shard only (init just
+                # needs a shape-correct slice, the values are irrelevant)
+                x = x.addressable_shards[0].data
+            return np.asarray(x[:min(len(x), n)])
+
+        micro = jax.tree_util.tree_map(host_slice, batch)
         variables = self.module.init({"params": rng, "dropout": rng}, micro)
         return variables["params"]
 
@@ -385,7 +399,8 @@ class DeepSpeedEngine:
                 lambda p: jnp.asarray(p, jnp.float32) if jnp.issubdtype(
                     jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
                 params_host) if self._keep_master else None
-            opt_state = self.optimizer_def.init(master if master is not None else params)
+            opt_state = None if self._onebit_wire else \
+                self.optimizer_def.init(master if master is not None else params)
 
         param_sh = policy.param_shardings(params)
         master_sh = policy.master_shardings(master) if master is not None else None
@@ -423,6 +438,13 @@ class DeepSpeedEngine:
             if scale_state is not None else None,
             "rng": rep,
         }
+        if self._onebit_wire:
+            # 1-bit compressed-exchange path: flat (m, v) + per-rank error
+            # buffers replace the OptimizerDef state (fp16/onebit/wire.py)
+            from .fp16.onebit import wire as onebit_wire
+            ob_state, ob_sh = onebit_wire.build_onebit_state(self, params)
+            state["onebit"] = ob_state
+            shardings["onebit"] = ob_sh
         self.state = state
         self._shardings = shardings
         self._num_params = count_parameters(params)
@@ -595,14 +617,25 @@ class DeepSpeedEngine:
                 lambda state, acc, n: finalize_grads(state, acc, n),
                 static_argnums=(2,), out_shardings=(state_sh, None, None))
             return
+        if self._onebit_wire:
+            from .fp16.onebit import wire as onebit_wire
+
+            self._jit_train_batch = onebit_wire.build_train_step(self)
+            self._jit_apply = None  # eager step() does not compose with
+            # the shard_map'd exchange; use train_batch()
+            return
+        # metrics are logically replicated scalars; saying so in
+        # out_shardings makes them addressable on EVERY process (a
+        # multi-process rank would otherwise fail to fetch the loss)
+        metrics_sh = _replicated(self.mesh)
         donate_state = jax.jit(
             fused_train_batch, donate_argnums=(0,),
-            out_shardings=(state_sh, None))
+            out_shardings=(state_sh, metrics_sh))
         self._jit_train_batch = donate_state
         self._jit_apply = jax.jit(
             lambda state, acc, n: update_from_grads(state, acc, n),
             donate_argnums=(0,), static_argnums=(2,),
-            out_shardings=(state_sh, None))
+            out_shardings=(state_sh, metrics_sh))
 
     def _make_grads_fn(self, micro_grads, constrain_grads, scale_value, gas):
         """Default gradient strategy: lax.scan over the gas micro-batches
@@ -803,6 +836,7 @@ class DeepSpeedEngine:
 
     def _after_step(self, metrics) -> None:
         self._last_grad_norm = metrics.get("grad_norm")
+        self._last_metrics = metrics
         if self.compression_scheduler is not None:
             self.compression_scheduler.step()
         at = self._config.autotuning
@@ -904,6 +938,12 @@ class DeepSpeedEngine:
     def step(self):
         """Apply the optimizer at a gradient-accumulation boundary —
         reference engine.step (engine.py:2017)."""
+        if self._onebit_wire:
+            raise RuntimeError(
+                "the eager forward()/backward()/step() API does not compose "
+                "with comm_backend_name=\"compressed\" (gradients must stay "
+                "rank-local inside the shard_map'd exchange) — drive "
+                "training with train_batch() instead")
         if (self.micro_steps % self.gradient_accumulation_steps()) != 0:
             return  # mid-accumulation; nothing to do (reference no-ops too)
         assert self._grad_acc is not None, "step() before backward()"

@@ -1,0 +1,277 @@
+"""1-bit Adam with the compressed exchange ON the wire.
+
+The dynamics-only optimizers in this package (``adam.py``/``lamb.py``)
+reproduce the reference's error-feedback compression *math* under GSPMD,
+where XLA moves dense fp32 gradients. This module is the wire-owning path
+(≅ reference ``deepspeed/runtime/fp16/onebit/adam.py:13`` +
+``runtime/comm/nccl.py:54 compressed_allreduce``): the engine's train step
+runs under ``shard_map`` over the data axis, gradients stay RANK-LOCAL
+(no automatic psum), and the cross-device exchange is:
+
+* warmup (``opt_step < freeze_step``): one dense fp32 ``psum`` of the
+  gradient — the reference's uncompressed warmup phase;
+* compression stage: each rank folds its LOCAL gradient into the momentum
+  and the momentum crosses the wire through
+  ``runtime/comm/compressed.compressed_allreduce`` — int8 signs + fp32
+  per-chunk scales via all_to_all + all_gather, with persistent per-rank
+  worker/server error feedback. The variance is frozen, exactly as the
+  dynamics-only path freezes it.
+
+Per-step logical wire volume (returned in metrics as ``comm_bytes``; the
+test suite asserts the drop and that the int8 collectives exist in HLO):
+dense ring-allreduce moves ~2·4·N·(w-1)/w ≈ 8N bytes/rank; the compressed
+exchange moves N int8 (all_to_all) + N int8 (all_gather) + scales ≈ 2N —
+the ~4x reduction the reference claims for its compression phase (16x is
+its 1-bit-packed wire format; XLA's narrowest collective dtype is int8).
+
+Scope (mirrors the reference's own constraints for 1-bit optimizers):
+pure data parallelism (mp = sp = pp = 1), ZeRO stage 0/1 semantics with a
+replicated fp32 master, bf16 compute (no dynamic loss scale), no gradient
+clipping in the compression stage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ....parallel import mesh as mesh_mod
+from ...comm.compressed import compressed_allreduce
+
+LANES = 128
+
+
+def is_enabled(config, mesh) -> bool:
+    """comm_backend_name="compressed" in the optimizer params activates the
+    wire path (reference config surface: onebit optimizers take
+    comm_backend_name, e.g. "nccl"; here "compressed" = shard_map int8
+    collectives, anything else = dynamics-only GSPMD)."""
+    opt = config.optimizer
+    if opt is None or opt.type is None:
+        return False
+    if opt.type.lower().replace("_", "") not in (
+            "onebitadam", "onebitlamb", "zerooneadam"):
+        return False
+    return dict(opt.params or {}).get("comm_backend_name") == "compressed"
+
+
+def check_supported(engine) -> None:
+    if engine.mp_world_size != 1 or \
+            mesh_mod.get_sequence_parallel_world_size() > 1:
+        raise ValueError("comm_backend_name=compressed supports pure data "
+                         "parallelism only (mp=sp=1)")
+    if engine.dp_world_size < 2:
+        raise ValueError("comm_backend_name=compressed needs dp_world > 1 "
+                         "(single rank has no wire to compress)")
+    if engine.fp16_enabled:
+        raise ValueError("comm_backend_name=compressed requires bf16 "
+                         "(dynamic loss scale does not compose with the "
+                         "frozen-variance compression stage)")
+    if engine.compute_dtype != jnp.bfloat16:
+        raise ValueError("comm_backend_name=compressed requires bf16 "
+                         "compute (the flat exchange needs the separate "
+                         "fp32 master that only non-fp32 compute keeps)")
+    if engine.zero_optimization_stage() > 0:
+        raise ValueError("comm_backend_name=compressed requires ZeRO stage "
+                         "0: the flat momentum exchange needs the replicated "
+                         "fp32 master (stage >= 1 shards it over the data "
+                         "axis; the reference's 1-bit optimizers are "
+                         "similarly restricted to ZeRO <= 1)")
+    opt_params = dict(engine._config.optimizer.params or {})
+    if opt_params.get("weight_decay", 0.0) and \
+            not opt_params.get("adam_w_mode", True):
+        raise ValueError("comm_backend_name=compressed supports AdamW-mode "
+                         "weight decay only (classic mode folds decay into "
+                         "the gradient, which the compression stage never "
+                         "sees after the exchange)")
+
+
+def build_onebit_state(engine, params):
+    """Extra engine-state entry: flat fp32 (m, v) + per-rank error buffers.
+
+    Global shapes: m/v (N,) replicated; worker error (world, N) and server
+    error (world, N // world) sharded over the data axis — each rank
+    persists only its own row.
+    """
+    world = engine.dp_world_size
+    flat, _ = jax.flatten_util.ravel_pytree(
+        jax.tree_util.tree_map(lambda p: jnp.zeros(np.shape(p), jnp.float32),
+                               params))
+    n = flat.shape[0]
+    n_pad = -(-n // (world * LANES)) * world * LANES
+    mesh = engine.mesh
+    rep = NamedSharding(mesh, P())
+    ranked = NamedSharding(mesh, P(mesh_mod.DATA_AXIS))
+    state = {
+        "m": jax.device_put(jnp.zeros((n_pad,), jnp.float32), rep),
+        "v": jax.device_put(jnp.zeros((n_pad,), jnp.float32), rep),
+        "we": jax.device_put(jnp.zeros((world, n_pad), jnp.float32), ranked),
+        "se": jax.device_put(jnp.zeros((world, n_pad // world), jnp.float32),
+                             ranked),
+    }
+    shardings = {"m": rep, "v": rep, "we": ranked, "se": ranked}
+    return state, shardings
+
+
+def build_train_step(engine):
+    """Compiled (state, stacked_batch) -> (state, metrics) with the
+    shard_map'd compressed exchange. Plugs in as the engine's
+    ``_jit_train_batch``."""
+    check_supported(engine)
+    mesh = engine.mesh
+    world = engine.dp_world_size
+    axis = mesh_mod.DATA_AXIS
+    loss_fn = engine._loss_fn
+    lr_fn = engine._lr_fn
+    gas = engine.gradient_accumulation_steps()
+    clip = engine.gradient_clipping()
+    compute_dtype = engine.compute_dtype
+
+    opt_params = dict(engine._config.optimizer.params or {})
+    beta1, beta2 = tuple(opt_params.get("betas", (0.9, 0.999)))
+    eps = opt_params.get("eps", 1e-8)
+    weight_decay = opt_params.get("weight_decay", 0.0)
+    freeze_step = opt_params.get("freeze_step", 100000)
+    adam_w_mode = opt_params.get("adam_w_mode", True)
+
+    sample_master = engine.state["master"]
+    flat0, unravel = jax.flatten_util.ravel_pytree(sample_master)
+    n = flat0.shape[0]
+    n_pad = engine.state["onebit"]["m"].shape[0]
+
+    # logical wire volume per rank per step (bytes) — see module docstring
+    dense_bytes = 2 * 4 * n_pad * (world - 1) // world
+    comp_bytes = (n_pad                      # all_to_all int8 signs
+                  + 4 * world                # all_to_all scales
+                  + n_pad                    # all_gather int8 signs
+                  + 4 * world)               # all_gather scales
+
+    def local_step(state, onebit, stacked_batch):
+        """Runs per-rank inside shard_map: batch leaves carry the LOCAL
+        shard; state replicated; onebit.we/se carry this rank's row."""
+        params = state["params"]
+
+        def one_micro(carry, xs):
+            mb, micro_index = xs
+            loss_acc, grads_acc = carry
+            rng = jax.random.fold_in(
+                jax.random.fold_in(state["rng"],
+                                   state["step"] * 1009 + micro_index),
+                jax.lax.axis_index(axis))
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p, mb, rng).astype(jnp.float32))(params)
+            return (loss_acc + loss,
+                    jax.tree_util.tree_map(jnp.add, grads_acc, grads)), None
+
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grads_sum), _ = jax.lax.scan(
+            one_micro, (jnp.zeros((), jnp.float32), zero_grads),
+            (stacked_batch, jnp.arange(gas)))
+        loss = jax.lax.pmean(loss_sum / gas, axis)
+
+        # local mean gradient, flattened + padded to the exchange layout
+        g_local = jax.flatten_util.ravel_pytree(
+            jax.tree_util.tree_map(lambda g: g / gas, grads_sum))[0]
+        g_local = jnp.pad(g_local, (0, n_pad - n))
+
+        m, v = onebit["m"], onebit["v"]
+        we = onebit["we"][0]          # this rank's rows
+        se = onebit["se"][0]
+        t = state["opt_step"].astype(jnp.float32) + 1.0
+
+        def warmup(_):
+            g = jax.lax.pmean(g_local, axis)
+            if clip > 0:
+                norm = jnp.sqrt(jnp.sum(g * g))
+                g = g * jnp.minimum(1.0, clip / (norm + 1e-6))
+            m_new = beta1 * m + (1.0 - beta1) * g
+            v_new = beta2 * v + (1.0 - beta2) * g * g
+            return m_new, v_new, we, se, jnp.asarray(dense_bytes, jnp.float32)
+
+        def compressed(_):
+            # fold the LOCAL gradient into the momentum; the exchange
+            # averages momenta across ranks (int8 on the wire)
+            m_local = beta1 * m + (1.0 - beta1) * g_local
+            m_new, we_new, se_new = compressed_allreduce(
+                m_local, we, se, axis_name=axis)
+            return m_new, v, we_new, se_new, \
+                jnp.asarray(comp_bytes, jnp.float32)
+
+        m_new, v_new, we_new, se_new, wire = jax.lax.cond(
+            t > freeze_step, compressed, warmup, operand=None)
+
+        # AdamW update on the replicated fp32 master
+        bc1 = 1.0 - beta1 ** t
+        bc2 = 1.0 - beta2 ** t
+        master_flat = jnp.pad(
+            jax.flatten_util.ravel_pytree(state["master"])[0], (0, n_pad - n))
+        denom = jnp.sqrt(v_new / bc2) + eps
+        update = (m_new / bc1) / denom
+        lr = lr_fn(state["step"])
+        new_flat = master_flat - lr * update
+        if weight_decay != 0.0 and adam_w_mode:
+            new_flat = new_flat - lr * weight_decay * master_flat
+        new_master = unravel(new_flat[:n])
+        new_params = jax.tree_util.tree_map(
+            lambda mp, p: mp.astype(p.dtype), new_master, params)
+
+        new_state = dict(state)
+        new_state["params"] = new_params
+        new_state["master"] = new_master
+        new_state["step"] = state["step"] + 1
+        new_state["opt_step"] = state["opt_step"] + 1
+        new_onebit = {"m": m_new, "v": v_new, "we": we_new[None],
+                      "se": se_new[None]}
+        # RMS proxy for ||mean_r g_r||: exact when ranks hold identical
+        # gradients, an upper bound otherwise — forming the true mean
+        # would cost the dense allreduce the compression stage exists to
+        # avoid
+        grad_norm = jnp.sqrt(
+            jax.lax.psum(jnp.sum(g_local * g_local), axis) / world)
+        metrics = {"loss": loss, "overflow": jnp.asarray(False),
+                   "grad_norm": grad_norm, "lr": lr,
+                   "loss_scale": jnp.asarray(1.0, jnp.float32),
+                   "comm_bytes": wire}
+        return new_state, new_onebit, metrics
+
+    rep = P()
+
+    def spec_like(tree, spec):
+        return jax.tree_util.tree_map(lambda _: spec, tree)
+
+    def train_batch(state, stacked_batch):
+        state = dict(state)
+        onebit = state.pop("onebit")
+        state_specs = spec_like(state, rep)
+        onebit_specs = {"m": rep, "v": rep,
+                        "we": P(axis, None), "se": P(axis, None)}
+        bspecs = jax.tree_util.tree_map(lambda _: P(None, axis),
+                                        stacked_batch)
+        metric_specs = spec_like(
+            {"loss": 0, "overflow": 0, "grad_norm": 0, "lr": 0,
+             "loss_scale": 0, "comm_bytes": 0}, rep)
+        # jax >= 0.8 renamed check_rep → check_vma; disable either way (the
+        # replicated outputs are made identical by the exchange itself)
+        import inspect
+        kw = {"check_vma": False} \
+            if "check_vma" in inspect.signature(shard_map).parameters \
+            else {"check_rep": False}
+        fn = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(state_specs, onebit_specs, bspecs),
+            out_specs=(state_specs, onebit_specs, metric_specs), **kw)
+        new_state, new_onebit, metrics = fn(state, onebit, stacked_batch)
+        new_state["onebit"] = new_onebit
+        return new_state, metrics
+
+    return jax.jit(train_batch, donate_argnums=(0,))

@@ -1,0 +1,122 @@
+"""1-bit Adam compressed exchange ON the wire (VERDICT r2 next #4).
+
+Three planes, all on the virtual 8-device mesh:
+  * volume accounting — metrics["comm_bytes"] must drop ~4x when the
+    compression stage starts (dense fp32 ring-allreduce vs int8
+    all_to_all + all_gather);
+  * HLO — the compiled step must CONTAIN s8 all-to-all/all-gather
+    collectives (fails if the compressed collective is bypassed);
+  * convergence — training through the freeze boundary keeps improving,
+    and tracks the dynamics-only (GSPMD) OneBitAdam path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer_lm import TransformerConfig, TransformerLM
+
+WORLD = 8
+FREEZE = 3
+
+
+def _config(freeze_step=FREEZE, backend="compressed", stage=0):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "zero_optimization": {"stage": stage},
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-3, "freeze_step": freeze_step,
+                                 **({"comm_backend_name": backend}
+                                    if backend else {})}},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10 ** 9,
+    }
+
+
+def _model():
+    return TransformerLM(TransformerConfig(
+        vocab_size=128, n_embd=32, n_layer=2, n_head=4, max_seq_len=32))
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": rng.integers(0, 128, (16, 32)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def test_comm_bytes_drop_at_freeze_boundary():
+    engine, _, _, _ = ds.initialize(model=_model(), config=_config())
+    dense, compressed = [], []
+    for i, b in enumerate(_batches(6)):
+        engine.train_batch(batch=b)
+        vol = float(engine._last_metrics["comm_bytes"])
+        (dense if i < FREEZE else compressed).append(vol)
+    assert all(v == dense[0] for v in dense)
+    assert all(v == compressed[0] for v in compressed)
+    ratio = dense[0] / compressed[0]
+    # dense ring allreduce ~8N vs int8 a2a+ag ~2N → ~4x (scales shave a hair)
+    assert 3.0 < ratio < 5.0, ratio
+
+
+def test_compiled_step_contains_int8_collectives():
+    engine, _, _, _ = ds.initialize(model=_model(), config=_config())
+    b = _batches(1)[0]
+    stacked = engine._stack_micro_batches(b)
+    if engine.state is None:
+        first = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        engine._build_state(engine._init_params_from_batch(first))
+    hlo = engine._jit_train_batch.lower(engine.state, stacked) \
+        .compile().as_text()
+    # the compressed exchange must be present as int8 collectives — this
+    # fails if gradient exchange silently reverts to dense fp32 only
+    assert "all-to-all" in hlo, "all_to_all collective missing from HLO"
+    s8_collective = any(
+        ("all-to-all" in line or "all-gather" in line) and "s8" in line
+        for line in hlo.splitlines())
+    assert s8_collective, "no int8 collective in the compiled step"
+
+
+def test_convergence_through_freeze_boundary():
+    batches = _batches(24, seed=1)
+
+    def run(backend):
+        engine, _, _, _ = ds.initialize(
+            model=_model(), config=_config(freeze_step=6, backend=backend))
+        return [float(engine.train_batch(batch=b)) for b in batches]
+
+    wired = run("compressed")
+    plain = run(None)  # dynamics-only GSPMD path
+    # both decrease end-to-end and the wired path tracks the dynamics-only
+    # path (identical warmup; compression differs only by the two-stage
+    # error-feedback quantization)
+    assert wired[-1] < wired[0]
+    assert plain[-1] < plain[0]
+    assert abs(wired[-1] - plain[-1]) < 0.35, (wired[-1], plain[-1])
+
+
+def test_state_has_per_rank_error_buffers():
+    engine, _, _, _ = ds.initialize(model=_model(), config=_config())
+    engine.train_batch(batch=_batches(1)[0])
+    ob = engine.state["onebit"]
+    n_pad = ob["m"].shape[0]
+    assert ob["we"].shape == (WORLD, n_pad)
+    assert ob["se"].shape == (WORLD, n_pad // WORLD)
+    # error buffers are sharded one row per rank over the data axis
+    assert ob["we"].sharding.spec == jax.sharding.PartitionSpec("data")
+
+
+def test_rejected_configs():
+    with pytest.raises(ValueError, match="ZeRO stage"):
+        ds.initialize(model=_model(), config=_config(stage=1))
+
+
+def test_compression_stage_actually_compresses():
+    """After freeze, worker error becomes non-zero (compression residual)."""
+    engine, _, _, _ = ds.initialize(model=_model(), config=_config())
+    for b in _batches(FREEZE + 2):
+        engine.train_batch(batch=b)
+    we = np.asarray(engine.state["onebit"]["we"])
+    assert np.abs(we).max() > 0, "worker error never updated — no compression"
