@@ -1,0 +1,123 @@
+"""Multi-process distributed test harness (SURVEY §4 "core pattern").
+
+The reference's ``DistributedExec`` (tests/unit/common.py:90) forks N
+processes that rendezvous through torch.distributed before each test body.
+The TPU translation: N REAL localhost processes, each forced onto the CPU
+backend, rendezvousing through ``deepspeed_tpu.init_distributed`` →
+``jax.distributed.initialize`` (Gloo CPU collectives), so cross-process
+collective plumbing — coordinator discovery, device federation (one CPU
+device per process), global-mesh construction — is genuinely exercised,
+unlike the single-process virtual-mesh tests.
+
+Usage: define a module-level worker ``def _my_worker(rank, world): ...`` in
+the test file and call ``run_distributed(_my_worker, world_size=2)``.
+Workers import the test file by path (no pickling), run the body, and exit
+non-zero on any exception; the parent enforces a hang watchdog and reprints
+worker logs on failure.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+_BOOTSTRAP = r"""
+import sys, os
+path, fn_name, rank, world, port, payload = sys.argv[1:7]
+os.environ["RANK"] = rank
+os.environ["WORLD_SIZE"] = world
+os.environ["MASTER_ADDR"] = "127.0.0.1"
+os.environ["MASTER_PORT"] = port
+import jax
+jax.config.update("jax_platforms", "cpu")  # before ANY backend use
+import deepspeed_tpu as ds
+ds.init_distributed()
+import importlib.util
+spec = importlib.util.spec_from_file_location("_dist_test_module", path)
+mod = importlib.util.module_from_spec(spec)
+sys.modules["_dist_test_module"] = mod
+spec.loader.exec_module(mod)
+fn = getattr(mod, fn_name)
+if payload == "-":
+    fn(int(rank), int(world))
+else:
+    fn(int(rank), int(world), payload)
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_distributed(fn, world_size: int = 2, timeout: float = 300.0,
+                    payload: str | None = None, env: dict | None = None):
+    """Run ``fn(rank, world[, payload])`` in ``world_size`` rendezvoused
+    localhost processes. ``fn`` must be module-level in the calling test
+    file. ``payload`` (optional string, e.g. a tmpdir) is forwarded to every
+    worker. Raises on non-zero exit or watchdog timeout, with worker logs.
+    """
+    path = os.path.abspath(sys.modules[fn.__module__].__file__)
+    port = free_port()
+    worker_env = dict(os.environ)
+    worker_env["PYTHONPATH"] = REPO_ROOT + os.pathsep + \
+        worker_env.get("PYTHONPATH", "")
+    # the virtual-mesh conftest env must not leak into the real
+    # multi-process rendezvous (each worker contributes its own device)
+    worker_env.pop("XLA_FLAGS", None)
+    worker_env.update(env or {})
+
+    logs, procs = [], []
+    for rank in range(world_size):
+        log = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=f".rank{rank}.log", delete=False)
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _BOOTSTRAP, path, fn.__name__,
+             str(rank), str(world_size), str(port),
+             payload if payload is not None else "-"],
+            stdout=log, stderr=subprocess.STDOUT, env=worker_env,
+            cwd=REPO_ROOT))
+
+    deadline = time.monotonic() + timeout
+    try:
+        rcs = []
+        for p in procs:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"distributed test hang: {fn.__name__} exceeded "
+                    f"{timeout}s (watchdog)")
+            rcs.append(p.wait(timeout=remaining))
+    except (TimeoutError, subprocess.TimeoutExpired) as e:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise TimeoutError(_format_failure(fn, logs, "WATCHDOG")) from e
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    if any(rc != 0 for rc in rcs):
+        raise AssertionError(_format_failure(fn, logs, rcs))
+
+
+def _format_failure(fn, logs, rcs) -> str:
+    out = [f"distributed worker failure in {fn.__name__}: rcs={rcs}"]
+    for i, log in enumerate(logs):
+        log.flush()
+        log.seek(0)
+        tail = log.read()[-4000:]
+        out.append(f"--- rank {i} log ---\n{tail}")
+    return "\n".join(out)
