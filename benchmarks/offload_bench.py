@@ -33,19 +33,24 @@ def make_opt(nbytes_total: int, n_leaves: int, serial: bool, tmpdir: str):
     opt = OffloadedOptimizer(params, {"lr": 1e-3}, cfg)
     if serial:
         # cripple the handle: 1 thread and a wait after every submit → the
-        # fully synchronous baseline
+        # fully synchronous baseline. SAME o_direct routing as the
+        # pipelined handle — the comparison must vary only the overlap,
+        # not the device path.
+        was_od = opt._aio.o_direct
         opt._aio.close()
-        opt._aio = AioHandle(num_threads=1)
+        opt._aio = AioHandle(num_threads=1, o_direct=was_od)
         real_pwrite = opt._aio.async_pwrite
         real_pread = opt._aio.async_pread
 
         def sync_pwrite(a, path, offset=0):
-            real_pwrite(a, path, offset)
+            t = real_pwrite(a, path, offset)
             opt._aio.wait()
+            return t
 
         def sync_pread(a, path, offset=0):
-            real_pread(a, path, offset)
+            t = real_pread(a, path, offset)
             opt._aio.wait()
+            return t
 
         opt._aio.async_pwrite = sync_pwrite
         opt._aio.async_pread = sync_pread
@@ -74,10 +79,17 @@ def main():
     ap.add_argument("--mb", type=int, default=256)
     ap.add_argument("--leaves", type=int, default=16)
     ap.add_argument("--dir", default="/tmp/ds_offload_bench")
+    ap.add_argument("--sim-bw-mbps", type=int, default=0,
+                    help="simulate a device of this aggregate bandwidth "
+                         "(chunk-proportional off-CPU sleeps in the AIO "
+                         "workers) — models a real NVMe where I/O waits "
+                         "idle the core; 0 = measure the real filesystem")
     args = ap.parse_args()
     import os
     import shutil
 
+    if args.sim_bw_mbps > 0:
+        os.environ["DS_AIO_SIM_US_PER_MB"] = str(10 ** 6 // args.sim_bw_mbps)
     shutil.rmtree(args.dir, ignore_errors=True)
     os.makedirs(args.dir)
     nbytes = args.mb << 20
@@ -87,6 +99,7 @@ def main():
     t_serial, timings_serial = bench(True, nbytes, args.leaves, args.dir)
     print(json.dumps({
         "master_mb": args.mb, "leaves": args.leaves,
+        "sim_bw_mbps": args.sim_bw_mbps or None,
         "pipelined_step_s": round(t_async, 3),
         "pipelined_phases": {k: round(v, 3) for k, v in timings_async.items()},
         "serial_step_s": round(t_serial, 3),

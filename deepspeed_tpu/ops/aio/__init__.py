@@ -56,17 +56,21 @@ class AioHandle:
         self.o_direct = o_direct
         self._refs = []  # keep submitted buffers alive until wait()
 
-    def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> None:
+    def async_pwrite(self, array: np.ndarray, path: str,
+                     offset: int = 0) -> int:
+        """Submit; returns a completion ticket for ``wait_ticket``."""
         a = np.ascontiguousarray(array)
         self._refs.append(a)
-        self._lib.ds_aio_pwrite(self._h, os.fsencode(path),
-                                a.ctypes.data, a.nbytes, offset)
+        return self._lib.ds_aio_pwrite(self._h, os.fsencode(path),
+                                       a.ctypes.data, a.nbytes, offset)
 
-    def async_pread(self, array: np.ndarray, path: str, offset: int = 0) -> None:
+    def async_pread(self, array: np.ndarray, path: str,
+                    offset: int = 0) -> int:
+        """Submit; returns a completion ticket for ``wait_ticket``."""
         assert array.flags["C_CONTIGUOUS"] and array.flags["WRITEABLE"]
         self._refs.append(array)
-        self._lib.ds_aio_pread(self._h, os.fsencode(path),
-                               array.ctypes.data, array.nbytes, offset)
+        return self._lib.ds_aio_pread(self._h, os.fsencode(path),
+                                      array.ctypes.data, array.nbytes, offset)
 
     # reference-named blocking variants (deepspeed_py_aio_handle's sync_*
     # calls return only after the I/O completes)
@@ -86,6 +90,14 @@ class AioHandle:
         if errors:
             raise IOError(f"aio: {errors} chunk(s) failed")
         return 0
+
+    def wait_ticket(self, ticket: int) -> None:
+        """Blocks until ONE submitted request completes (the pipelined
+        swap-in path: wait for a leaf's read while later leaves keep
+        streaming). Buffers stay referenced until a full ``wait()``."""
+        errors = self._lib.ds_aio_wait_ticket(self._h, ticket)
+        if errors:
+            raise IOError(f"aio: {errors} chunk(s) failed (ticket {ticket})")
 
     def pending(self) -> int:
         return self._lib.ds_aio_pending(self._h)

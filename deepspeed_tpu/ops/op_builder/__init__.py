@@ -128,9 +128,11 @@ class AsyncIOBuilder(OpBuilder):
         for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
             fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
                            ctypes.c_int64, ctypes.c_int64]
-            fn.restype = None
+            fn.restype = ctypes.c_int64  # completion ticket
         lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
         lib.ds_aio_wait.restype = ctypes.c_int64
+        lib.ds_aio_wait_ticket.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ds_aio_wait_ticket.restype = ctypes.c_int64
         lib.ds_aio_pending.argtypes = [ctypes.c_void_p]
         lib.ds_aio_pending.restype = ctypes.c_int64
         lib.ds_aio_probe_o_direct.argtypes = [ctypes.c_char_p]

@@ -105,10 +105,20 @@ class OffloadedOptimizer:
                                  getattr(ac, "__fields_set__", set()))
             threads = ac.thread_count if "thread_count" in ac_set \
                 else max(1, config.buffer_count)
+            # NVMe-tier semantics: bypass the page cache when the target
+            # filesystem allows it (the reference's aio kernels are
+            # O_DIRECT-always) — that is also what makes swap-out writes
+            # block in the DEVICE, freeing the core for the overlapped Adam
+            # compute. DS_AIO_NO_ODIRECT=1 forces the buffered path.
+            from ...ops.aio import o_direct_supported
+
+            use_od = os.environ.get("DS_AIO_NO_ODIRECT") != "1" and \
+                o_direct_supported(self.nvme_dir)
             self._aio = AioHandle(
                 num_threads=max(1, threads),
                 block_size=ac.block_size if ac else 1 << 20,
                 queue_depth=ac.queue_depth if ac else 0,
+                o_direct=use_od,
                 single_submit=ac.single_submit if ac else False,
                 overlap_events=ac.overlap_events if ac else True)
             self._swap_out_all()
@@ -140,6 +150,39 @@ class OffloadedOptimizer:
         self._aio.wait()
         self._drop_stores()
 
+    @staticmethod
+    def _alloc(n: int) -> np.ndarray:
+        """4096-aligned fp32 buffer — unaligned pointers silently fall back
+        to the buffered fd in the AIO chunk router, which would defeat the
+        O_DIRECT device path the NVMe tier relies on."""
+        from ...ops.aio import aligned_array
+
+        return aligned_array(n * 4).view(np.float32)
+
+    def _submit_swap_in_all(self) -> Dict[str, list]:
+        """Allocate every swapped-out leaf's buffers and SUBMIT their reads
+        without draining. Returns {leaf: [tickets]} for per-leaf
+        ``wait_ticket`` — the pipelined step overlaps leaf i's Adam compute
+        with leaves i+1..'s reads."""
+        tickets: Dict[str, list] = {}
+        for p, shape in self._shapes.items():
+            if not self._float[p]:
+                continue
+            if self.m[p] is not None:
+                continue  # in-memory copy live (see _swap_in_all)
+            n = int(np.prod(shape)) if shape else 1
+            self.m[p] = self._alloc(n)
+            self.v[p] = self._alloc(n)
+            self.master[p] = self._alloc(n).reshape(shape)
+            tickets[p] = [
+                self._aio.async_pread(self.m[p], self._leaf_file(p, "m")),
+                self._aio.async_pread(self.v[p], self._leaf_file(p, "v")),
+                self._aio.async_pread(
+                    self.master[p].reshape(-1) if shape else
+                    self.master[p].ravel(), self._leaf_file(p, "master")),
+            ]
+        return tickets
+
     def _swap_in_all(self) -> None:
         for p, shape in self._shapes.items():
             if not self._float[p]:
@@ -150,9 +193,9 @@ class OffloadedOptimizer:
                 # reading the file would clobber good state with garbage
                 continue
             n = int(np.prod(shape)) if shape else 1
-            self.m[p] = np.empty(n, np.float32)
-            self.v[p] = np.empty(n, np.float32)
-            self.master[p] = np.empty(shape, np.float32)
+            self.m[p] = self._alloc(n)
+            self.v[p] = self._alloc(n)
+            self.master[p] = self._alloc(n).reshape(shape)
             self._aio.async_pread(self.m[p], self._leaf_file(p, "m"))
             self._aio.async_pread(self.v[p], self._leaf_file(p, "v"))
             self._aio.async_pread(self.master[p].reshape(-1) if shape else
@@ -199,19 +242,22 @@ class OffloadedOptimizer:
         (already unscaled/clipped). Returns the new compute-dtype param
         pytree (host arrays, ready for device_put). ``step_num`` 1-indexed.
 
-        NVMe tier pipelining (≅ PipelinedOptimizerSwapper): reads for all
-        leaves are submitted up front and overlap each other across the
-        AIO thread pool; each leaf's swap-OUT writes are submitted the
-        moment its Adam update finishes, so writes overlap the remaining
-        leaves' compute, with one drain at the end. ``last_timings``
-        records the phase breakdown {swap_in_s, compute_s, drain_s}."""
+        NVMe tier pipelining (≅ PipelinedOptimizerSwapper): ALL leaves'
+        swap-in reads are submitted up front and the compute loop waits
+        per-leaf (``wait_ticket``) — leaf i's Adam update runs while leaves
+        i+1.. are still streaming in; each leaf's swap-OUT writes are then
+        submitted the moment its update finishes, so writes overlap the
+        remaining compute, with one drain at the end. ``last_timings``
+        records {swap_in_s (first leaf's read wait), compute_s (incl.
+        overlapped read waits), drain_s}."""
         import time
 
         import ml_dtypes
 
         t0 = time.perf_counter()
+        tickets: Dict[str, list] = {}
         if self.nvme:
-            self._swap_in_all()
+            tickets = self._submit_swap_in_all()
         t_in = time.perf_counter()
         grads = _flatten_with_paths(grads_host)
         out: Dict[str, np.ndarray] = {}
@@ -222,6 +268,15 @@ class OffloadedOptimizer:
                 if not self._float[p]:
                     out[p] = master
                     continue
+                if p in tickets:
+                    # wait for THIS leaf's reads only; later leaves keep
+                    # streaming while this one computes (popped only after
+                    # ALL its reads land — a failed wait leaves it in
+                    # `tickets` so the unwind drops its garbage buffers)
+                    for t in tickets[p]:
+                        self._aio.wait_ticket(t)
+                    del tickets[p]
+                    master = self.master[p]
                 g = np.ascontiguousarray(
                     np.asarray(grads[p], np.float32)).ravel()
                 self.opt.step(
@@ -247,12 +302,19 @@ class OffloadedOptimizer:
             # non-raising here: an IOError raised inside cleanup would
             # REPLACE the original in-flight exception (the root cause).
             if self.nvme:
+                # leaves whose reads never completed hold UNINITIALIZED
+                # buffers — drop them so retry re-reads from disk instead
+                # of treating garbage as authoritative in-memory state
+                for p in tickets:
+                    self.m[p] = self.v[p] = None
+                    self.master[p] = None
                 try:
                     self._aio.wait()
                 except IOError as io_err:
                     # a failed drain means the on-disk leaf files may be
-                    # partially written — keep the in-memory copies (do NOT
-                    # _drop_stores) so they stay authoritative for retry
+                    # partially written — keep the completed leaves'
+                    # in-memory copies (no _drop_stores) so they stay
+                    # authoritative for retry
                     logger.warning("swap-out drain failed during exception "
                                    "unwind: %s — keeping in-memory optimizer "
                                    "state authoritative", io_err)
