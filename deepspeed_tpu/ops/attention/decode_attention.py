@@ -13,11 +13,13 @@ Layout: q (B, H, D); k/v cache (B, KV, S, D) — the model's cache layout
 Mosaic block-tiling rules). Grouped-query attention maps query head h to
 kv head h // (H // KV) in the BlockSpec index map. ``lengths`` (B,) masks
 cache slots >= length. Optional ALiBi slopes add the reference's alibi
-bias. Blocks past every sequence's length skip the COMPUTE (dynamic
-``pl.when``) — but the BlockSpec still DMAs those K/V blocks into VMEM,
-so HBM traffic scales with the grid's S extent, not the live length.
-Bounding the bandwidth cost requires the caller to pass a cache view
-sliced to (a multiple of ``block_s`` covering) the max live length.
+bias. Blocks past a sequence's length are dead: ``pl.when`` skips their
+compute, and the K/V index maps CLAMP dead grid steps to the sequence's
+last live block — consecutive grid steps with the same block index elide
+the DMA (Pallas revisiting rule), so HBM traffic ALSO tracks the live
+length (one redundant block fetch at the boundary), not the allocated
+capacity. Decoding at position p costs O(p), the realistic generate()
+regime where p << max_seq_len.
 """
 
 from __future__ import annotations
@@ -131,15 +133,21 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     # scalar prefetch (SMEM, fully resident) and index maps receive them as
     # trailing args per the PrefetchScalarGridSpec contract
     q3 = q.reshape(B * H, 1, D)
+
+    def kv_index(b, h, j, len_ref, slope_ref):
+        # clamp dead steps to the last LIVE block: consecutive identical
+        # indices elide the DMA, so bandwidth tracks the live length
+        last_live = jnp.maximum(
+            (len_ref[b] + block_s - 1) // block_s - 1, 0)
+        return (b, h // rep, jnp.minimum(j, last_live), 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, D), lambda b, h, j, *_: (b * H + h, 0, 0)),
-            pl.BlockSpec((1, 1, block_s, D),
-                         lambda b, h, j, *_: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, block_s, D),
-                         lambda b, h, j, *_: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_s, D), kv_index),
+            pl.BlockSpec((1, 1, block_s, D), kv_index),
         ],
         out_specs=pl.BlockSpec((1, 1, D),
                                lambda b, h, j, *_: (b * H + h, 0, 0)),
