@@ -1,0 +1,99 @@
+"""Int8 serving bench: bf16 vs int8-at-rest decode on the real chip.
+
+Measures what the int8 compute tier exists for (reference int8 inference,
+docs/_posts/2021-03-16-mixture-of-quantization ff.): HBM weight footprint
+and decode throughput of the whole-loop compiled generate() on a
+TransformerLM, bf16 engine vs dtype=int8 engine (QuantDense + Pallas
+dequant-GEMM). Writes benchmarks/int8_bench_results.json.
+
+Usage: python benchmarks/int8_bench.py [--layers N] [--embd D] [--tokens T]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_bytes(tree):
+    return sum(l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(tree))
+
+
+def bench_engine(engine, ids, n_tokens, repeats=3):
+    engine.generate(ids, max_new_tokens=n_tokens)  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        toks = engine.generate(ids, max_new_tokens=n_tokens)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    n_new = toks.shape[1] - ids.shape[1]
+    return {"tokens_per_s": n_new * ids.shape[0] / dt, "elapsed_s": dt,
+            "param_bytes": tree_bytes(engine.params)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=24)
+    ap.add_argument("--embd", type=int, default=1536)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--tokens", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    args = ap.parse_args()
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer_lm import (
+        TransformerLM,
+        transformer_config,
+    )
+
+    cfg = transformer_config("llama", vocab_size=args.vocab,
+                             n_embd=args.embd, n_layer=args.layers,
+                             n_head=args.heads, max_seq_len=args.seq)
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, args.vocab, (args.batch, 16)), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, ids,
+                        method=model.logits)["params"]
+    n_params = sum(l.size for l in jax.tree_util.tree_leaves(params))
+    print(f"model: {n_params/1e6:.1f}M params, {args.layers}L {args.embd}d",
+          flush=True)
+
+    rows = {}
+    for dtype in ("bfloat16", "int8"):
+        eng = deepspeed_tpu.init_inference(model, model_parameters=params,
+                                           dtype=dtype)
+        rows[dtype] = bench_engine(eng, ids, args.tokens)
+        print(dtype, rows[dtype], flush=True)
+        del eng
+
+    result = {
+        "model": {"params_m": n_params / 1e6, "layers": args.layers,
+                  "embd": args.embd, "vocab": args.vocab,
+                  "batch": args.batch, "decode_tokens": args.tokens},
+        "bf16": rows["bfloat16"],
+        "int8": rows["int8"],
+        "footprint_ratio": rows["int8"]["param_bytes"] /
+                           rows["bfloat16"]["param_bytes"],
+        "decode_speedup": rows["int8"]["tokens_per_s"] /
+                          rows["bfloat16"]["tokens_per_s"],
+        "backend": jax.default_backend(),
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "int8_bench_results.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -77,7 +77,8 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
             raise ValueError(f"unsupported inference dtype {self.dtype!r}; "
                              f"supported: {sorted(table)}")
         if key == "int8":
-            logger.warning(
-                "dtype=int8: weight quantization tier not wired into the "
-                "inference engine yet — compute runs in bfloat16")
+            logger.info(
+                "dtype=int8: weights stored int8; single-device LM serving "
+                "computes via the Pallas dequant-GEMM (activations bf16), "
+                "TP>1 and non-LM modules dequantize in-jit")
         return table[key]

@@ -112,7 +112,11 @@ class InferenceEngine:
                 spec = PartitionSpec(*([None] * np.ndim(leaf)))
             return NamedSharding(self.mesh, spec)
 
-        params, self._quant_scales = self._maybe_quantize(params)
+        if self._use_int8_compute():
+            params = self._quantize_structured(params)
+            self._quant_scales = None
+        else:
+            params, self._quant_scales = self._maybe_quantize(params)
         self._param_shardings = jax.tree_util.tree_map_with_path(leaf_sharding, params)
         self.params = jax.device_put(params, self._param_shardings)
         if hasattr(self.module, "logits"):
@@ -132,6 +136,87 @@ class InferenceEngine:
     def _quant_enabled(self) -> bool:
         return self._config.quant.enabled or \
             "int8" in str(self._config.dtype)
+
+    # -- int8 COMPUTE tier -------------------------------------------------
+    # When the served module is the unified TransformerLM family, int8
+    # doesn't stop at storage: the Dense layers are swapped for QuantDense
+    # (int8 kernel + f32 per-output-channel scale) and every matmul runs
+    # the Pallas dequant-GEMM (ops/quantization/int8_matmul.py) — the
+    # reference's fused csrc/transformer/inference dequantize path.
+    # Weights stream from HBM as int8 even inside the whole-loop decode
+    # scan, where the storage tier's XLA dequant would be hoisted into a
+    # materialized bf16 copy. TP>1 keeps the storage tier (the Pallas call
+    # is not yet partition-annotated for GSPMD).
+    # ----------------------------------------------------------------------
+    _INT8_DENSE_KEYS = frozenset({
+        "q_proj", "k_proj", "v_proj", "o_proj",
+        "up_proj", "gate_proj", "down_proj", "lm_head"})
+
+    def _use_int8_compute(self) -> bool:
+        cfg = getattr(self.module, "config", None)
+        return (self._quant_enabled()
+                and self._config.quant.bits == 8
+                and self.mp_world_size == 1
+                # QuantDense computes in bf16; honor an explicit f32
+                # request by keeping the dequant storage tier instead
+                and self.dtype == jnp.bfloat16
+                and hasattr(cfg, "int8_weights")
+                and not getattr(cfg, "int8_weights"))
+
+    def _quantize_structured(self, params):
+        """bf16 param tree -> QuantDense tree (int8 kernel, f32 scale) for
+        every Dense in the LM; rebuilds the serving module with
+        ``int8_weights=True``."""
+        import dataclasses
+
+        from ..ops.quantization import pad_features, quantize_columns
+
+        def quantize_kernel(kern):
+            kern = np.asarray(kern, np.float32)
+            n = kern.shape[-1]
+            n_pad = pad_features(n)
+            if n_pad != n:
+                pad = [(0, 0)] * (kern.ndim - 1) + [(0, n_pad - n)]
+                kern = np.pad(kern, pad)
+            if kern.ndim == 2:
+                q, s = quantize_columns(kern)
+            else:  # nn.scan-stacked (L, K, N)
+                qs = [quantize_columns(layer) for layer in kern]
+                q = np.stack([a for a, _ in qs])
+                s = np.stack([b for _, b in qs])
+            return jnp.asarray(q), jnp.asarray(s)
+
+        n_dense = 0
+
+        def walk(tree):
+            nonlocal n_dense
+            out = {}
+            for key, val in tree.items():
+                if not isinstance(val, (dict, type(None))) and \
+                        hasattr(val, "items"):
+                    val = dict(val)
+                if key in self._INT8_DENSE_KEYS and isinstance(val, dict) \
+                        and "kernel" in val and np.ndim(val["kernel"]) >= 2:
+                    q, s = quantize_kernel(val["kernel"])
+                    new = {"kernel": q, "scale": s}
+                    if "bias" in val:
+                        new["bias"] = val["bias"]
+                    out[key] = new
+                    n_dense += 1
+                elif isinstance(val, dict):
+                    out[key] = walk(val)
+                else:
+                    out[key] = val
+            return out
+
+        import flax
+
+        qparams = walk(flax.core.unfreeze(params))
+        self._serve_module = self.module.clone(config=dataclasses.replace(
+            self.module.config, int8_weights=True))
+        log_dist(f"inference int8 compute tier: {n_dense} Dense kernels -> "
+                 "QuantDense (Pallas dequant-GEMM)", ranks=[0])
+        return qparams
 
     def _maybe_quantize(self, params):
         if not self._quant_enabled():
@@ -177,7 +262,7 @@ class InferenceEngine:
         return jax.tree_util.tree_map_with_path(visit, params)
 
     def _build_jits(self) -> None:
-        module = self.module
+        module = getattr(self, "_serve_module", None) or self.module
         dequant = self._dequant
 
         def logits_fn(params, input_ids):

@@ -68,6 +68,8 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     decode_kernel: str = "auto"         # auto | on | off (fused Pallas decode)
+    int8_weights: bool = False          # serve with int8-at-rest Dense kernels
+    int8_kernel: str = "auto"           # auto | on | off (Pallas dequant-GEMM)
 
     @property
     def head_dim(self) -> int:
@@ -122,6 +124,21 @@ def transformer_sharding_rules():
         (r"mlp/down_proj/kernel", (None, M, None)),
         (r"lm_head/kernel", (None, M)),
     ]
+
+
+def _dense(cfg: TransformerConfig, features: int, *, use_bias: bool,
+           name: str, dtype=None):
+    """nn.Dense, or its int8-at-rest serving twin when ``cfg.int8_weights``
+    — params become int8 kernel + f32 per-channel scale consumed by the
+    Pallas dequant-GEMM (ops/quantization); the inference engine's
+    quantization tier builds that tree from a bf16 checkpoint."""
+    if cfg.int8_weights:
+        from ..ops.quantization import QuantDense
+
+        return QuantDense(features, use_bias=use_bias, dtype=dtype or cfg.dtype,
+                          kernel_mode=cfg.int8_kernel, name=name)
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype or cfg.dtype,
+                    name=name)
 
 
 def _norm(cfg: TransformerConfig, name: str):
@@ -200,8 +217,8 @@ class CachedAttention(nn.Module):
         cfg = self.config
         B, T, C = x.shape
         H, KV, D = cfg.n_head, cfg.kv_heads, cfg.head_dim
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            feats, use_bias=cfg.qkv_bias, dtype=cfg.dtype, name=name)
+        dense = lambda feats, name: _dense(  # noqa: E731
+            cfg, feats, use_bias=cfg.qkv_bias, name=name)
         q = dense(H * D, "q_proj")(x).reshape(B, T, H, D)
         k = dense(KV * D, "k_proj")(x).reshape(B, T, KV, D)
         v = dense(KV * D, "v_proj")(x).reshape(B, T, KV, D)
@@ -251,8 +268,7 @@ class CachedAttention(nn.Module):
                     q[:, 0].astype(cfg.dtype), k_all, v_all, start + 1,
                     alibi_slopes=slopes, block_s=pick_block_s(S))
                 y = y.astype(cfg.dtype).reshape(B, 1, H * D)
-                return nn.Dense(C, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
-                                name="o_proj")(y)
+                return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
             # row t may see cache slots [0, start+t]
             mask = (jnp.arange(S)[None, :] <= (start + jnp.arange(T))[:, None])
         else:
@@ -281,7 +297,7 @@ class CachedAttention(nn.Module):
         y = jnp.einsum("bhts,bhsd->bthd", att,
                        v_all.astype(jnp.float32)).astype(cfg.dtype)
         y = y.reshape(B, T, H * D)
-        return nn.Dense(C, use_bias=cfg.qkv_bias, dtype=cfg.dtype, name="o_proj")(y)
+        return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
 
 
 class TransformerMLP(nn.Module):
@@ -293,18 +309,14 @@ class TransformerMLP(nn.Module):
         hidden = int(cfg.mlp_ratio * cfg.n_embd)
         if cfg.activation == "swiglu":
             # llama sizing: 2/3 * 4d rounded — callers control via mlp_ratio
-            gate = nn.Dense(hidden, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                            name="gate_proj")(x)
-            up = nn.Dense(hidden, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                          name="up_proj")(x)
+            gate = _dense(cfg, hidden, use_bias=cfg.mlp_bias, name="gate_proj")(x)
+            up = _dense(cfg, hidden, use_bias=cfg.mlp_bias, name="up_proj")(x)
             h = jax.nn.silu(gate) * up
         else:
-            h = nn.Dense(hidden, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                         name="up_proj")(x)
+            h = _dense(cfg, hidden, use_bias=cfg.mlp_bias, name="up_proj")(x)
             h = jax.nn.gelu(h, approximate=True) if cfg.activation == "gelu" \
                 else jax.nn.relu(h)
-        h = nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                     name="down_proj")(h)
+        h = _dense(cfg, cfg.n_embd, use_bias=cfg.mlp_bias, name="down_proj")(h)
         if cfg.dropout > 0:
             h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         return h
@@ -364,8 +376,8 @@ class TransformerLM(nn.Module):
         )(cfg, name="blocks")
         self.ln_f = _norm(cfg, "ln_f")
         if not cfg.tie_word_embeddings:
-            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
-                                    dtype=jnp.float32, name="lm_head")
+            self.lm_head = _dense(cfg, cfg.vocab_size, use_bias=False,
+                                  dtype=jnp.float32, name="lm_head")
 
     def _transform(self, input_ids, positions, decode, deterministic):
         cfg = self.config

@@ -1,0 +1,11 @@
+"""Int8 serving compute: Pallas dequant-GEMM + the int8-at-rest Dense.
+
+TPU analog of the reference's ``csrc/quantization`` inference kernels.
+"""
+
+from .int8_matmul import (  # noqa: F401
+    int8_matmul,
+    int8_matmul_reference,
+    quantize_columns,
+)
+from .linear import QuantDense, pad_features  # noqa: F401
