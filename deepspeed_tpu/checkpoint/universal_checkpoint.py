@@ -81,6 +81,45 @@ def universal_dir(base_dir: str, tag: str) -> str:
     return os.path.join(base_dir, str(tag) + UNIVERSAL_SUFFIX)
 
 
+def _orbax_to_state_dict(ckpt_dir: str, tag: str,
+                         orbax_path: str) -> Dict[str, Any]:
+    """Read an orbax-layout checkpoint (the multi-process save path) into
+    the pickle-layout state-dict shape. Offloaded optimizer state is
+    per-process sidecar files whose host shards this offline converter
+    cannot re-assemble — convert those checkpoints from a running engine
+    (``save_checkpoint`` on a non-offload engine after load) instead."""
+    from ..runtime.checkpoint_engine.orbax_checkpoint_engine import (
+        OrbaxCheckpointEngine,
+    )
+
+    offload_files = [f for f in os.listdir(os.path.join(ckpt_dir, str(tag)))
+                     if f.startswith("offload_pp_rank_")
+                     and not f.endswith(".meta")]
+    if offload_files:
+        raise NotImplementedError(
+            f"universal conversion of an offload checkpoint saved by "
+            f"multiple processes ({len(offload_files)} per-rank offload "
+            f"files in {ckpt_dir}/{tag}) is not supported offline — "
+            "resave from an engine with offload disabled, or convert the "
+            "single-process pickle layout")
+    blob = OrbaxCheckpointEngine(use_async=False).load(orbax_path,
+                                                       to_host=True)
+    arrays, meta = blob["arrays"], blob.get("meta", {})
+    sd: Dict[str, Any] = {
+        "module": arrays.get("params"),
+        "master": arrays.get("master"),
+        "optimizer": arrays.get("opt_state"),
+        "offload_optimizer": None,
+        "step": arrays.get("step"),
+        "opt_step": arrays.get("opt_step", arrays.get("step")),
+    }
+    for key in ("global_steps", "global_samples", "micro_steps",
+                "skipped_steps", "dp_world_size", "mp_world_size",
+                "lr_scheduler"):
+        sd[key] = meta.get(key)
+    return sd
+
+
 def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
                     output_dir: Optional[str] = None) -> str:
     """Convert a saved checkpoint into the universal format — the analog of
@@ -92,9 +131,21 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
 
     if tag is None:
         tag = read_latest(ckpt_dir)
-    engine = ArrayCheckpointEngine()
-    sd = engine.load(checkpoint_meta_path(ckpt_dir, tag, "model",
-                                          mp_rank=0, dp_rank=0))
+    pickle_path = checkpoint_meta_path(ckpt_dir, tag, "model",
+                                       mp_rank=0, dp_rank=0)
+    orbax_path = os.path.join(ckpt_dir, str(tag), "orbax_state")
+    if os.path.exists(pickle_path + ".meta"):
+        engine = ArrayCheckpointEngine()
+        sd = engine.load(pickle_path)
+    elif os.path.isdir(orbax_path):
+        # multi-process saves (engine.save_checkpoint orbax branch) store a
+        # sharded array tree + meta sidecar; map it onto the single-file
+        # state-dict shape this converter consumes
+        sd = _orbax_to_state_dict(ckpt_dir, tag, orbax_path)
+    else:
+        raise FileNotFoundError(
+            f"no checkpoint at {ckpt_dir}/{tag}: neither "
+            f"{pickle_path}.meta nor {orbax_path} exists")
     out = universal_dir(output_dir or ckpt_dir, tag)
     os.makedirs(out, exist_ok=True)
 

@@ -51,13 +51,27 @@ class OrbaxCheckpointEngine(CheckpointEngine):
                 pickle.dump(meta, f)
 
     def load(self, path: str, map_location=None,
-             restore_target: Any = None) -> Any:
+             restore_target: Any = None, to_host: bool = False) -> Any:
         """``restore_target``: pytree of jax.ShapeDtypeStruct with shardings
         (or concrete arrays) directing where shards land — this is how a
-        universal-style re-shard on load happens with orbax."""
+        universal-style re-shard on load happens with orbax.
+
+        ``to_host``: restore every leaf as host numpy regardless of how it
+        was sharded at save time — the offline-tool path (ds_to_universal
+        over a multi-process checkpoint has no meshes to restore onto)."""
         path = os.path.abspath(path)
         kwargs = {}
-        if restore_target is not None:
+        if to_host and restore_target is None:
+            import jax
+            import numpy as np
+
+            md = self._ckptr.metadata(path)
+            # StepMetadata wraps the stored pytree (ArrayMetadata leaves)
+            md_tree = getattr(getattr(md, "item_metadata", md), "tree", md)
+            kwargs["restore_args"] = jax.tree_util.tree_map(
+                lambda _: self._ocp.RestoreArgs(restore_type=np.ndarray),
+                md_tree)
+        elif restore_target is not None:
             # tolerate save/load config mismatches in OPTIONAL top-level
             # entries (fp16 scale, master, opt_state): restrict the target
             # to what the checkpoint actually stores (from its metadata)

@@ -183,3 +183,40 @@ def test_orbax_engine_sharded_roundtrip(tmp_path, eight_device_mesh):
     np.testing.assert_array_equal(np.asarray(out["arrays"]["w"]),
                                   np.asarray(arr))
     assert out["arrays"]["w"].sharding.is_equivalent_to(sh, 2)
+
+
+def test_universal_from_orbax_layout(tmp_path):
+    """ds_to_universal over a checkpoint saved through the ORBAX engine
+    (the multi-process save layout: orbax_state dir + meta sidecar, no
+    pickle files) — regression for the elastic-loop composition where a
+    2-proc run's checkpoint must convert offline (VERDICT r2 #8)."""
+    from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import (
+        OrbaxCheckpointEngine,
+    )
+
+    engine = _make_engine()
+    b = _batch(engine)
+    for _ in range(2):
+        engine.train_batch(batch=b)
+    engine.checkpoint_engine = OrbaxCheckpointEngine(use_async=False)
+    engine.save_checkpoint(str(tmp_path))
+    import os
+
+    tag = "global_step2"
+    assert os.path.isdir(os.path.join(str(tmp_path), tag, "orbax_state"))
+    assert not os.path.exists(os.path.join(
+        str(tmp_path), tag, "mp_rank_00_model_states.meta"))
+
+    univ = ds_to_universal(str(tmp_path))
+    blob = load_universal(univ)
+    assert blob["meta"]["global_steps"] == 2
+    assert blob["fp32"], "fp32 weights missing from orbax conversion"
+    assert blob["opt"], "optimizer moments missing from orbax conversion"
+
+    engine2 = _make_engine()
+    engine2.train_batch(batch=b)
+    engine2.load_universal_checkpoint(str(tmp_path))
+    assert engine2.global_steps == 2
+    l1 = float(engine.train_batch(batch=b))
+    l2 = float(engine2.train_batch(batch=b))
+    assert np.isclose(l1, l2, rtol=1e-3), (l1, l2)
