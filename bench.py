@@ -80,7 +80,7 @@ def main():
     tokens_per_step = engine.train_batch_size() * SEQ
     tok_s_chip = tokens_per_step * steps / dt / n_chips
 
-    n_params = engine._num_params
+    n_params = engine.num_parameters
     # three accountings, strictest to reference-convention (see module doc)
     attn_full = 12 * N_LAYER * SEQ * N_EMBD       # QK^T + AV, fwd+bwd
     f_6n = 6 * n_params

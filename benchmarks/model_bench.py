@@ -52,7 +52,6 @@ def main():
 
     import deepspeed_tpu as ds
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
-    from deepspeed_tpu.runtime.utils import count_parameters
 
     spec = MODELS[args.model]
     flash = {"auto": "auto", "on": True, "off": False}[args.flash]
@@ -90,7 +89,7 @@ def main():
     jax.block_until_ready(loss)
 
     tokens_per_step = engine.train_batch_size() * args.seq
-    n_params = engine._num_params
+    n_params = engine.num_parameters
 
     row = {
         "model": args.model, "params_m": round(n_params / 1e6, 1),

@@ -1,0 +1,146 @@
+"""ZeRO-Inference at larger-than-HBM scale on the real chip.
+
+The single-chip analog of the reference's BLOOM-176B ZeRO-Inference
+headline (docs/_posts/2022-09-10-zero-inference.md:21): a model several
+times the device's HBM lives host-resident and streams through the chip
+one transformer layer at a time via :class:`ZeroInferenceEngine`.
+Records scoring throughput (tokens/s) and the effective host→device
+streaming bandwidth, which on this harness is bounded by the axon tunnel
+(~0.3 GB/s measured), not PCIe/DMA — noted in BASELINE.md.
+
+Weights are random (the throughput claim doesn't depend on their values);
+every layer gets its own physical buffer (no broadcast aliasing — the
+host-RAM footprint and per-layer transfers are real), filled from one
+random template to keep setup O(minutes).
+
+Usage: python benchmarks/zero_inference_bench.py --params-b 32
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_host_params(model, cfg, ids, std=0.01):
+    """Full host-resident bf16 param tree from a single random template
+    layer (shapes via eval_shape — nothing big ever touches the device)."""
+    import ml_dtypes
+
+    bf16 = ml_dtypes.bfloat16
+    shapes = jax.eval_shape(
+        lambda r: model.init({"params": r}, ids, method=model.logits),
+        jax.random.PRNGKey(0))["params"]
+    rng = np.random.default_rng(0)
+
+    def fill(path, sd):
+        shape = sd.shape
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if len(shape) >= 1 and shape[0] == cfg.n_layer and "blocks" in name:
+            # scan-stacked: one random template layer, copied to every slice
+            template = rng.standard_normal(shape[1:], np.float32)
+            template = (template * std).astype(bf16) if "kernel" in name or \
+                "embedding" in name else (
+                np.ones(shape[1:], bf16) if name.endswith("scale")
+                else np.zeros(shape[1:], bf16))
+            out = np.empty(shape, bf16)
+            out[:] = template  # broadcast copy: distinct physical bytes
+            return out
+        if name.endswith("scale"):
+            return np.ones(shape, bf16)
+        if name.endswith("bias"):
+            return np.zeros(shape, bf16)
+        return (rng.standard_normal(shape, np.float32) * std).astype(bf16)
+
+    return jax.tree_util.tree_map_with_path(fill, shapes)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--params-b", type=float, default=32.0,
+                    help="target model size in billions of parameters")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--passes", type=int, default=3)
+    args = ap.parse_args()
+
+    from deepspeed_tpu.inference.zero_inference import ZeroInferenceEngine
+    from deepspeed_tpu.models.transformer_lm import (
+        TransformerLM,
+        transformer_config,
+    )
+
+    # size the model: params ≈ 12 * L * d^2 (+ embed); fix d, solve L
+    d = 6656 if args.params_b >= 8 else 2048
+    L = max(2, round(args.params_b * 1e9 / (12 * d * d)))
+    cfg = transformer_config(
+        "gpt2", vocab_size=32000, n_embd=d, n_layer=L,
+        n_head=d // args.head_dim, max_seq_len=args.seq,
+        decode_kernel="off")
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(1).integers(
+        0, 32000, (args.batch, args.seq)), jnp.int32)
+
+    t0 = time.perf_counter()
+    host = build_host_params(model, cfg, ids[:1, :8])
+    total_bytes = sum(np.asarray(l).nbytes
+                      for l in jax.tree_util.tree_leaves(host))
+    n_params = sum(np.asarray(l).size
+                   for l in jax.tree_util.tree_leaves(host))
+    print(f"built {n_params/1e9:.2f}B params ({total_bytes/1e9:.1f} GB "
+          f"host-resident) in {time.perf_counter()-t0:.0f}s", flush=True)
+
+    engine = ZeroInferenceEngine(cfg, host, dtype=jnp.bfloat16, prefetch=1)
+    stream_bytes = sum(np.asarray(l).nbytes for l in
+                       jax.tree_util.tree_leaves(host["blocks"]["block"]))
+
+    print("warm pass (compile + first stream)...", flush=True)
+    t0 = time.perf_counter()
+    engine.score(ids)
+    warm_s = time.perf_counter() - t0
+    print(f"warm: {warm_s:.0f}s", flush=True)
+
+    # the axon tunnel's throughput fluctuates on ~10-min scales; report the
+    # best of N passes (the achievable streaming rate) plus the spread
+    times = []
+    for i in range(args.passes):
+        t0 = time.perf_counter()
+        ll = engine.score(ids)
+        times.append(time.perf_counter() - t0)
+        print(f"pass {i}: {times[-1]:.0f}s", flush=True)
+    dt = min(times)
+    assert np.all(np.isfinite(ll)), "non-finite scores"
+    tokens = args.batch * args.seq
+    result = {
+        "params_b": n_params / 1e9,
+        "model_gb": total_bytes / 1e9,
+        "hbm_gb": 16.0,
+        "model_x_hbm": total_bytes / 16e9,
+        "batch": args.batch, "seq": args.seq,
+        "layers": L, "d_model": d,
+        "score_tokens_per_s": tokens / dt,
+        "elapsed_s": dt,
+        "pass_times_s": [round(t, 1) for t in times],
+        "warm_s": warm_s,
+        "stream_gb_per_pass": stream_bytes / 1e9,
+        "effective_host_to_device_gbps": stream_bytes / dt / 1e9,
+        "mean_loglik": float(np.mean(ll)),
+        "backend": jax.default_backend(),
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "zero_inference_results.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
