@@ -160,6 +160,16 @@ class ZeroInferenceEngine:
             # the slot's previous transfer must be on-device before its
             # host buffer is overwritten (dispatch runs ahead of execution)
             self._staging_dev[slot].block_until_ready()
+        # release guard refs for transfers that already landed, so the
+        # device footprint stays O(prefetch+1 layers): the consumer
+        # (`forward`'s buffers dict) is the only remaining owner
+        for s, dev in enumerate(self._staging_dev):
+            if dev is not None and s != slot:
+                try:
+                    if dev.is_ready():
+                        self._staging_dev[s] = None
+                except AttributeError:
+                    break  # runtime without is_ready: keep refs as guards
         buf = self._staging[slot]
         offs = 0
         for leaf in leaves:
@@ -178,25 +188,42 @@ class ZeroInferenceEngine:
             offs += size
         return jax.tree_util.tree_unflatten(self._layer_treedef, leaves)
 
-    def forward(self, input_ids) -> jnp.ndarray:
-        """Full-context logits with layer streaming."""
+    def forward(self, input_ids, layer_times: Optional[list] = None
+                ) -> jnp.ndarray:
+        """Full-context logits with layer streaming.
+
+        ``layer_times``: optional list; when given, each layer's
+        stage+dispatch+execute wall time is appended (the benchmark's
+        per-layer instrumentation hook — synchronizes per layer, so only
+        pass it when measuring)."""
+        import time as _time
+
         ids = jnp.asarray(input_ids, jnp.int32)
         if ids.ndim == 1:
             ids = ids[None]
         x = self._jit_embed(self._small["embed_tokens"],
                             self._small.get("embed_pos"),
                             self._small.get("embed_ln"), ids)
+        if layer_times is not None:
+            x.block_until_ready()
         # pipeline: enqueue next layers' uploads before blocking on compute
         buffers = {}
         for j in range(min(self.prefetch + 1, self.n_layer)):
             buffers[j] = self._put_layer(j)
         for i in range(self.n_layer):
+            t0 = _time.perf_counter()
             layer = buffers.pop(i)
             nxt = i + self.prefetch + 1
             if nxt < self.n_layer:
                 buffers[nxt] = self._put_layer(nxt)  # async upload
             x = self._jit_block(layer, x)
-            del layer  # device buffer freed after the block consumes it
+            # the engine's ref is dropped here; the buffer is freed once
+            # the block consumes it and the staging guard's transfer ref
+            # is released (see _put_layer)
+            del layer
+            if layer_times is not None:
+                x.block_until_ready()
+                layer_times.append(_time.perf_counter() - t0)
         return self._jit_head(self._small["embed_tokens"],
                               self._small["ln_f"],
                               self._small.get("lm_head"), x)
@@ -211,7 +238,14 @@ class ZeroInferenceEngine:
         ids = jnp.asarray(input_ids, jnp.int32)
         if ids.ndim == 1:
             ids = ids[None]
-        logits = self.forward(ids)
+        return self.score_logits(self.forward(ids), ids)
+
+    def score_logits(self, logits, input_ids) -> np.ndarray:
+        """The scoring tail over already-computed logits (one jitted
+        program + the readback). Split out so callers that must control
+        readback ordering (see benchmarks/zero_inference_bench.py) reuse
+        the shipped tail instead of re-deriving it."""
+        ids = jnp.asarray(input_ids, jnp.int32)
         if not hasattr(self, "_jit_score_tail"):
             def tail(logits, ids):
                 logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
