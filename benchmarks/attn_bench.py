@@ -17,7 +17,10 @@ import functools
 import json
 import math
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def timed(scalar_fn, *args, iters=20):

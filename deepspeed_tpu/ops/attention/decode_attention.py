@@ -35,7 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import LANES, NEG_INF, SUBLANES, _interpret
 
-DEFAULT_BLOCK_S = 512
+DEFAULT_BLOCK_S = 1024
 
 
 def pick_block_s(cache_len: int, preferred: int = DEFAULT_BLOCK_S) -> int:
