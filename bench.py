@@ -115,4 +115,18 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # the tunneled backend's remote-compile service intermittently 500s
+    # (observed r3: "tpu_compile_helper subprocess exit code 1" for ~hours);
+    # retry with backoff so a transient outage doesn't zero the round
+    attempts = 4
+    for attempt in range(attempts):
+        try:
+            main()
+            break
+        except Exception as e:  # noqa: BLE001
+            if attempt == attempts - 1:
+                raise
+            import sys
+            print(f"bench attempt {attempt + 1} failed ({e}); retrying "
+                  f"in 180s", file=sys.stderr, flush=True)
+            time.sleep(180)
