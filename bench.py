@@ -118,7 +118,7 @@ if __name__ == "__main__":
     # the tunneled backend's remote-compile service intermittently 500s
     # (observed r3: "tpu_compile_helper subprocess exit code 1" for ~hours);
     # retry with backoff so a transient outage doesn't zero the round
-    attempts = 4
+    attempts = 6
     for attempt in range(attempts):
         try:
             main()
@@ -127,6 +127,7 @@ if __name__ == "__main__":
             if attempt == attempts - 1:
                 raise
             import sys
+            delay = 120 * (attempt + 1)
             print(f"bench attempt {attempt + 1} failed ({e}); retrying "
-                  f"in 180s", file=sys.stderr, flush=True)
-            time.sleep(180)
+                  f"in {delay}s", file=sys.stderr, flush=True)
+            time.sleep(delay)
