@@ -25,9 +25,27 @@ import numpy as np
 V5E_PEAK_TFLOPS = 197.0
 
 
+def _enable_persistent_cache():
+    """Persistent XLA compilation cache: once this bench's programs have
+    compiled on this machine, later runs (the driver's end-of-round run)
+    reuse them even while the tunneled remote-compile service is down."""
+    import os
+
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+
 def main():
     import jax
     import jax.numpy as jnp
+
+    _enable_persistent_cache()
 
     import deepspeed_tpu as ds
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel

@@ -21,6 +21,17 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def _enable_persistent_cache():
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
 V5E_PEAK_TFLOPS = 197.0  # bf16
 
 MODELS = {
@@ -48,6 +59,7 @@ def main():
                     choices=["auto", "on", "off"],
                     help="Pallas flash attention kernel selection")
     args = ap.parse_args()
+    _enable_persistent_cache()
 
     import jax
     import numpy as np
