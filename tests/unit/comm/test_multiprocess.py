@@ -151,3 +151,46 @@ def test_multiprocess_engine_train():
 def test_multiprocess_checkpoint_resume():
     with tempfile.TemporaryDirectory() as d:
         run_distributed(_checkpoint_worker, world_size=2, payload=d)
+
+
+def _onebit_wire_worker(rank, world):
+    """1-bit Adam with the compressed collective across REAL process
+    boundaries: the int8 exchange must rendezvous and training must keep
+    improving through the freeze boundary (VERDICT r2 #4 x #5)."""
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer_lm import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    model = TransformerLM(TransformerConfig(
+        vocab_size=64, n_embd=32, n_layer=2, n_head=4, max_seq_len=32))
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "zero_optimization": {"stage": 0},
+                "optimizer": {"type": "OneBitAdam",
+                              "params": {"lr": 1e-3, "freeze_step": 2,
+                                         "comm_backend_name": "compressed"}},
+                "bf16": {"enabled": True},
+                "steps_per_print": 10 ** 9})
+    rng = np.random.default_rng(0)  # same data every rank (SPMD contract)
+    batch = {"input_ids": rng.integers(0, 64, (2 * world * 2, 32)
+                                       ).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
+    assert all(np.isfinite(losses)), losses
+    # steps 3-5 run the compressed exchange (freeze_step=2); memorizing a
+    # fixed batch must keep improving DURING the compressed phase — not
+    # just end-vs-start, which the uncompressed warmup steps alone satisfy
+    assert losses[-1] < losses[1], losses
+
+    from deepspeed_tpu.comm import comm as dist
+    dist.assert_same_across_ranks(
+        {"wire_losses": [round(l, 5) for l in losses]}, "onebit wire losses")
+
+
+def test_multiprocess_onebit_compressed_wire():
+    run_distributed(_onebit_wire_worker, world_size=2)
