@@ -99,14 +99,39 @@ class ZeroInferenceEngine:
         # while the identical loop without donation held ~1.5 GB/s.
         self._jit_block = jax.jit(block_fn)
 
+        def cached_block_init_fn(layer_params, x):
+            # first (prefill) pass: flax creates the cache collection
+            # itself — layout, names and dtype stay the module's concern
+            if self.pack:
+                layer_params = self._unpack(layer_params)
+            out, vars_ = block.apply({"params": layer_params}, x, True,
+                                     True, mutable=["cache"])
+            return out, vars_["cache"]
+
+        def cached_block_fn(layer_params, cache, x):
+            if self.pack:
+                layer_params = self._unpack(layer_params)
+            out, vars_ = block.apply(
+                {"params": layer_params, "cache": cache}, x, True, True,
+                mutable=["cache"])
+            return out, vars_["cache"]
+
+        self._jit_cached_block_init = jax.jit(cached_block_init_fn)
+        # the cache IS donated: it is device-resident and round-trips
+        # through this same jit (in-place update, no full-cache copy per
+        # layer per token). The no-donation NOTE above concerns
+        # host->device-transferred buffers only.
+        self._jit_cached_block = jax.jit(cached_block_fn,
+                                         donate_argnums=(1,))
+
         from ..models.transformer_lm import _norm
 
-        def embed_fn(emb, pos_emb, emb_ln, ids):
+        def embed_fn(emb, pos_emb, emb_ln, ids, start):
             B, T = ids.shape
             table = emb["embedding"]
             x = jnp.take(table, ids, axis=0)
             if pos_emb is not None:
-                pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+                pos = jnp.broadcast_to(start + jnp.arange(T)[None], (B, T))
                 x = x + jnp.take(pos_emb["embedding"], pos, axis=0)
             if emb_ln is not None:
                 # bloom-family embedding layernorm (transformer_lm.py:332)
@@ -203,7 +228,8 @@ class ZeroInferenceEngine:
             ids = ids[None]
         x = self._jit_embed(self._small["embed_tokens"],
                             self._small.get("embed_pos"),
-                            self._small.get("embed_ln"), ids)
+                            self._small.get("embed_ln"), ids,
+                            jnp.zeros((), jnp.int32))
         if layer_times is not None:
             x.block_until_ready()
         # pipeline: enqueue next layers' uploads before blocking on compute
@@ -239,6 +265,77 @@ class ZeroInferenceEngine:
         if ids.ndim == 1:
             ids = ids[None]
         return self.score_logits(self.forward(ids), ids)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+        """Autoregressive generation under weight streaming — the serving
+        mode of the reference's ZeRO-Inference (BLOOM-176B generation,
+        docs/_posts/2022-09-10-zero-inference.md): weights stay
+        host-resident and stream through the chip per step, while the KV
+        caches (which DO fit — O(L·B·S·D), not O(params)) stay
+        device-resident across the whole generation.
+
+        ``temperature`` 0 = greedy. Returns (B, T_prompt + new) int32.
+        """
+        cfg = self.config
+        ids = jnp.asarray(input_ids, jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        B, T = ids.shape
+        S = cfg.max_seq_len
+        if max_new_tokens <= 0:
+            return np.asarray(ids)
+        if T + max_new_tokens > S:
+            raise ValueError(f"prompt({T}) + max_new_tokens"
+                             f"({max_new_tokens}) exceeds max_seq_len({S})")
+        caches = [None] * self.n_layer
+
+        if not hasattr(self, "_jit_sample"):
+            def sample(logits, rng, temperature):
+                last = logits[:, -1, :].astype(jnp.float32)
+                greedy = jnp.argmax(last, axis=-1)
+                sampled = jax.random.categorical(
+                    rng, last / jnp.maximum(temperature, 1e-6), axis=-1)
+                return jnp.where(temperature > 0, sampled, greedy) \
+                    .astype(jnp.int32)
+
+            self._jit_sample = jax.jit(sample)
+
+        rng = jax.random.PRNGKey(seed)
+        temp = jnp.asarray(temperature, jnp.float32)
+
+        def stream_pass(tokens, start, first=False):
+            x = self._jit_embed(self._small["embed_tokens"],
+                                self._small.get("embed_pos"),
+                                self._small.get("embed_ln"), tokens,
+                                jnp.asarray(start, jnp.int32))
+            buffers = {j: self._put_layer(j)
+                       for j in range(min(self.prefetch + 1, self.n_layer))}
+            for i in range(self.n_layer):
+                layer = buffers.pop(i)
+                nxt = i + self.prefetch + 1
+                if nxt < self.n_layer:
+                    buffers[nxt] = self._put_layer(nxt)
+                if first:
+                    x, caches[i] = self._jit_cached_block_init(layer, x)
+                else:
+                    x, caches[i] = self._jit_cached_block(layer, caches[i], x)
+                del layer
+            return self._jit_head(self._small["embed_tokens"],
+                                  self._small["ln_f"],
+                                  self._small.get("lm_head"), x)
+
+        logits = stream_pass(ids, 0, first=True)  # prefill builds caches
+        rng, sub = jax.random.split(rng)
+        token = self._jit_sample(logits, sub, temp)
+        out = [token]
+        for step in range(max_new_tokens - 1):
+            logits = stream_pass(token[:, None], T + step)
+            rng, sub = jax.random.split(rng)
+            token = self._jit_sample(logits, sub, temp)
+            out.append(token)
+        return np.concatenate([np.asarray(ids)] +
+                              [np.asarray(t)[:, None] for t in out], axis=1)
 
     def score_logits(self, logits, input_ids) -> np.ndarray:
         """The scoring tail over already-computed logits (one jitted

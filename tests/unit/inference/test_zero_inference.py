@@ -102,3 +102,38 @@ def test_memmap_host_weights(tmp_path):
     out = ZeroInferenceEngine(cfg, mapped, dtype=jnp.float32)(ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_streamed_generate_matches_resident():
+    """generate() under weight streaming (per-token layer restream, KV
+    caches device-resident) must produce the same greedy tokens as the
+    all-on-device engine's generate — the ZeRO-Inference serving mode
+    (reference docs/_posts/2022-09-10-zero-inference.md)."""
+    import deepspeed_tpu as ds
+
+    cfg, model, params = _model_and_params(family="llama")
+    ids = jnp.asarray(np.random.default_rng(5)
+                      .integers(0, 64, (2, 6)).astype(np.int32))
+
+    resident = ds.init_inference(model, model_parameters=params,
+                                 dtype="float32")
+    expect = resident.generate(ids, max_new_tokens=6)
+
+    zi = ZeroInferenceEngine(cfg, jax.device_get(params), dtype=jnp.float32)
+    got = zi.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expect))
+
+
+def test_streamed_generate_contracts():
+    """Engine-dtype != config-dtype must still generate (cache dtype is
+    the module's, not the engine's), and max_new_tokens=0 returns the
+    prompt — both matching the resident engine's contracts."""
+    cfg, model, params = _model_and_params()
+    ids = jnp.asarray(np.random.default_rng(6)
+                      .integers(0, 64, (2, 5)).astype(np.int32))
+    zi = ZeroInferenceEngine(cfg, jax.device_get(params),
+                             dtype=jnp.bfloat16)  # cfg is float32
+    out = zi.generate(ids, max_new_tokens=3)
+    assert out.shape == (2, 8) and (out[:, :5] == np.asarray(ids)).all()
+    np.testing.assert_array_equal(zi.generate(ids, max_new_tokens=0),
+                                  np.asarray(ids))
