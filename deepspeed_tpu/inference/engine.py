@@ -148,9 +148,8 @@ class InferenceEngine:
     # materialized bf16 copy. TP>1 keeps the storage tier (the Pallas call
     # is not yet partition-annotated for GSPMD).
     # ----------------------------------------------------------------------
-    _INT8_DENSE_KEYS = frozenset({
-        "q_proj", "k_proj", "v_proj", "o_proj",
-        "up_proj", "gate_proj", "down_proj", "lm_head"})
+    # single source of truth for which modules are QuantDense-convertible
+    from ..ops.quantization.convert import DENSE_KEYS as _INT8_DENSE_KEYS
 
     def _use_int8_compute(self) -> bool:
         cfg = getattr(self.module, "config", None)
@@ -169,49 +168,10 @@ class InferenceEngine:
         ``int8_weights=True``."""
         import dataclasses
 
-        from ..ops.quantization import pad_features, quantize_columns
+        from ..ops.quantization.convert import quantize_lm_params
 
-        def quantize_kernel(kern):
-            kern = np.asarray(kern, np.float32)
-            n = kern.shape[-1]
-            n_pad = pad_features(n)
-            if n_pad != n:
-                pad = [(0, 0)] * (kern.ndim - 1) + [(0, n_pad - n)]
-                kern = np.pad(kern, pad)
-            if kern.ndim == 2:
-                q, s = quantize_columns(kern)
-            else:  # nn.scan-stacked (L, K, N)
-                qs = [quantize_columns(layer) for layer in kern]
-                q = np.stack([a for a, _ in qs])
-                s = np.stack([b for _, b in qs])
-            return jnp.asarray(q), jnp.asarray(s)
-
-        n_dense = 0
-
-        def walk(tree):
-            nonlocal n_dense
-            out = {}
-            for key, val in tree.items():
-                if not isinstance(val, (dict, type(None))) and \
-                        hasattr(val, "items"):
-                    val = dict(val)
-                if key in self._INT8_DENSE_KEYS and isinstance(val, dict) \
-                        and "kernel" in val and np.ndim(val["kernel"]) >= 2:
-                    q, s = quantize_kernel(val["kernel"])
-                    new = {"kernel": q, "scale": s}
-                    if "bias" in val:
-                        new["bias"] = val["bias"]
-                    out[key] = new
-                    n_dense += 1
-                elif isinstance(val, dict):
-                    out[key] = walk(val)
-                else:
-                    out[key] = val
-            return out
-
-        import flax
-
-        qparams = walk(flax.core.unfreeze(params))
+        qparams, n_dense = quantize_lm_params(
+            params, dense_keys=self._INT8_DENSE_KEYS)
         self._serve_module = self.module.clone(config=dataclasses.replace(
             self.module.config, int8_weights=True))
         log_dist(f"inference int8 compute tier: {n_dense} Dense kernels -> "

@@ -45,7 +45,23 @@ class ZeroInferenceEngine:
     """
 
     def __init__(self, config: TransformerConfig, params_host: Dict,
-                 dtype=jnp.bfloat16, prefetch: int = 1, pack: bool = True):
+                 dtype=jnp.bfloat16, prefetch: int = 1, pack: bool = True,
+                 int8: bool = False):
+        if int8 and not config.int8_weights:
+            # int8 ZeRO-Inference: quantize the Dense kernels host-side
+            # (QuantDense layout) so each streamed layer is ~half the
+            # bytes AND the dequant runs inside the Pallas GEMM on chip.
+            # The head stays in the always-resident tier, so it is left
+            # unquantized (head_fn consumes a plain kernel).
+            import dataclasses
+
+            from ..ops.quantization.convert import DENSE_KEYS, quantize_lm_params
+
+            params_host, n_dense = quantize_lm_params(
+                params_host, dense_keys=DENSE_KEYS - {"lm_head"})
+            config = dataclasses.replace(config, int8_weights=True)
+            log_dist(f"ZeroInference int8 tier: {n_dense} Dense kernels -> "
+                     "QuantDense (streamed int8-at-rest)", ranks=[0])
         self.config = config
         self.dtype = dtype
         self.prefetch = max(0, prefetch)
@@ -58,16 +74,27 @@ class ZeroInferenceEngine:
         # many small leaves; leaves are re-sliced on device by a jitted
         # unpack (an HBM-local copy)
         self.pack = pack
-        leaves, self._layer_treedef = jax.tree_util.tree_flatten(
-            _slice_layer(self._stacked, 0))
-        self._leaf_shapes = [np.shape(l) for l in leaves]
-        self._leaf_sizes = [int(np.prod(s)) for s in self._leaf_shapes]
-        # jnp.issubdtype, not np: ml_dtypes bfloat16 (the host storage
-        # dtype of bf16 checkpoints) is not an np.floating subtype
-        self._leaf_float = [jnp.issubdtype(np.asarray(l).dtype, jnp.floating)
-                            for l in leaves]
-        if not all(self._leaf_float):
-            self.pack = False  # mixed dtypes: ship leaves individually
+        leaves_wp, self._layer_treedef = \
+            jax.tree_util.tree_flatten_with_path(_slice_layer(self._stacked, 0))
+        # the packed buffer is raw BYTES, so any leaf-dtype mix ships as
+        # one transfer (bf16 checkpoints, int8 QuantDense kernels with
+        # f32 scales, ...). Float leaves are converted to the engine
+        # compute dtype at stage time — except "scale" leaves, which are
+        # per-channel quantization/norm scales that stay full precision.
+        # (jnp.issubdtype, not np: ml_dtypes bfloat16 is not an
+        # np.floating subtype.)
+        def wire_dtype(path, leaf):
+            d = np.asarray(leaf).dtype
+            if not jnp.issubdtype(d, jnp.floating):
+                return d
+            if getattr(path[-1], "key", None) == "scale":
+                return d
+            return np.dtype(dtype)
+
+        self._leaf_shapes = [np.shape(l) for _, l in leaves_wp]
+        self._leaf_wire_dtypes = [wire_dtype(p, l) for p, l in leaves_wp]
+        self._leaf_nbytes = [int(np.prod(s)) * d.itemsize for s, d in
+                             zip(self._leaf_shapes, self._leaf_wire_dtypes)]
 
         # small always-resident pieces: embeddings, final norm, head
         def put_small(name):
@@ -162,9 +189,13 @@ class ZeroInferenceEngine:
     def _put_layer(self, i: int):
         layer = _slice_layer(self._stacked, i)
         if not self.pack:
-            return jax.device_put(jax.tree_util.tree_map(
-                lambda a: jnp.asarray(a, self.dtype) if jnp.issubdtype(
-                    a.dtype, jnp.floating) else jnp.asarray(a), layer))
+            # same wire-dtype rule as the packed path (floats -> compute
+            # dtype, "scale" leaves and non-floats keep storage dtype)
+            leaves = jax.tree_util.tree_leaves(layer)
+            conv = [np.asarray(l, wdt) for l, wdt in
+                    zip(leaves, self._leaf_wire_dtypes)]
+            return jax.device_put(jax.tree_util.tree_unflatten(
+                self._layer_treedef, conv))
         leaves = jax.tree_util.tree_leaves(layer)
         # rotating staging buffers, NOT a fresh array per layer: (a) the
         # runtime retains a host reference per staged transfer, so fresh
@@ -175,8 +206,8 @@ class ZeroInferenceEngine:
         # transfer shares a buffer with the layer being staged.
         if not hasattr(self, "_staging"):
             n_buf = self.prefetch + 2
-            total = sum(self._leaf_sizes)
-            self._staging = [np.empty(total, self.dtype) for _ in range(n_buf)]
+            total = sum(self._leaf_nbytes)
+            self._staging = [np.empty(total, np.uint8) for _ in range(n_buf)]
             self._staging_dev = [None] * n_buf
             self._staging_i = 0
         slot = self._staging_i
@@ -197,20 +228,34 @@ class ZeroInferenceEngine:
                     break  # runtime without is_ready: keep refs as guards
         buf = self._staging[slot]
         offs = 0
-        for leaf in leaves:
-            flat_leaf = np.asarray(leaf, self.dtype).reshape(-1)
-            buf[offs:offs + flat_leaf.size] = flat_leaf
-            offs += flat_leaf.size
-        dev = jax.device_put(buf)
+        for leaf, wdt, nb in zip(leaves, self._leaf_wire_dtypes,
+                                 self._leaf_nbytes):
+            flat_leaf = np.asarray(leaf, wdt).reshape(-1).view(np.uint8)
+            buf[offs:offs + nb] = flat_leaf
+            offs += nb
+        # CPU backend: device_put ZERO-COPIES host numpy, so a reused
+        # staging buffer would alias a live device array — hand it a
+        # private copy there (tests-only path; real accelerators copy on
+        # transfer and keep the rotating-buffer RSS/pinning wins)
+        payload = buf.copy() if jax.default_backend() == "cpu" else buf
+        dev = jax.device_put(payload)
         self._staging_dev[slot] = dev
         return dev
 
     def _unpack(self, flat):
-        """Traced: packed layer buffer -> leaf tree (HBM-local slices)."""
+        """Traced: packed byte buffer -> leaf tree (HBM-local bitcasts)."""
         offs, leaves = 0, []
-        for shape, size in zip(self._leaf_shapes, self._leaf_sizes):
-            leaves.append(flat[offs:offs + size].reshape(shape))
-            offs += size
+        for shape, wdt, nb in zip(self._leaf_shapes, self._leaf_wire_dtypes,
+                                  self._leaf_nbytes):
+            seg = flat[offs:offs + nb]
+            jdt = jnp.dtype(wdt)
+            if jdt.itemsize > 1:
+                seg = jax.lax.bitcast_convert_type(
+                    seg.reshape(-1, jdt.itemsize), jdt)
+            else:
+                seg = jax.lax.bitcast_convert_type(seg, jdt)
+            leaves.append(seg.reshape(shape))
+            offs += nb
         return jax.tree_util.tree_unflatten(self._layer_treedef, leaves)
 
     def forward(self, input_ids, layer_times: Optional[list] = None

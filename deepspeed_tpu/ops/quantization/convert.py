@@ -1,0 +1,77 @@
+"""Param-tree conversion to the int8 serving layout.
+
+Shared by the inference engine's int8 compute tier and int8
+ZeRO-Inference streaming: every Dense kernel in a TransformerLM param
+tree becomes {kernel: int8, scale: f32 per-output-channel} consumed by
+:class:`QuantDense` (reference analog: ``weight_quantizer.py`` +
+``csrc/transformer/inference/csrc/dequantize.cu``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .int8_matmul import quantize_columns
+from .linear import pad_features
+
+DENSE_KEYS = frozenset({
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "up_proj", "gate_proj", "down_proj", "lm_head"})
+
+
+def _quantize_one(kern2d):
+    """One (K, N) kernel -> padded int8 + f32 column scales. Materializes
+    only this kernel in f32 (memmap-friendly: a stacked (L, K, N) leaf is
+    processed one layer slice at a time by the caller)."""
+    kern2d = np.asarray(kern2d, np.float32)
+    n = kern2d.shape[-1]
+    n_pad = pad_features(n)
+    if n_pad != n:
+        kern2d = np.pad(kern2d, ((0, 0), (0, n_pad - n)))
+    return quantize_columns(kern2d)
+
+
+def _quantize_kernel(kern):
+    # NOTE: outputs are host numpy ON PURPOSE — callers that stream
+    # (ZeroInferenceEngine) must not have the quantized model committed
+    # to device memory; the resident engine device_puts the tree itself.
+    if np.ndim(kern) == 2:
+        return _quantize_one(kern)
+    qs = [_quantize_one(layer) for layer in kern]  # nn.scan-stacked
+    return (np.stack([a for a, _ in qs]),
+            np.stack([b for _, b in qs]))
+
+
+def quantize_lm_params(params, dense_keys=DENSE_KEYS) -> Tuple[dict, int]:
+    """bf16/f32 TransformerLM param tree -> QuantDense tree (host numpy).
+    Returns (quantized tree, number of Dense kernels converted). Memmap
+    inputs are read one layer slice at a time; the OUTPUT int8 tree is
+    materialized in host RAM (~0.5x the bf16 checkpoint bytes)."""
+    import flax
+
+    n_dense = 0
+
+    def walk(tree):
+        nonlocal n_dense
+        out = {}
+        for key, val in tree.items():
+            if not isinstance(val, (dict, type(None))) and \
+                    hasattr(val, "items"):
+                val = dict(val)
+            if key in dense_keys and isinstance(val, dict) \
+                    and "kernel" in val and np.ndim(val["kernel"]) >= 2:
+                q, s = _quantize_kernel(val["kernel"])
+                new = {"kernel": q, "scale": s}
+                if "bias" in val:
+                    new["bias"] = val["bias"]
+                out[key] = new
+                n_dense += 1
+            elif isinstance(val, dict):
+                out[key] = walk(val)
+            else:
+                out[key] = val
+        return out
+
+    return walk(flax.core.unfreeze(params)), n_dense

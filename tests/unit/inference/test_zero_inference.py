@@ -137,3 +137,28 @@ def test_streamed_generate_contracts():
     assert out.shape == (2, 8) and (out[:, :5] == np.asarray(ids)).all()
     np.testing.assert_array_equal(zi.generate(ids, max_new_tokens=0),
                                   np.asarray(ids))
+
+
+def test_int8_streaming_tier():
+    """int8=True quantizes the streamed Dense kernels to the QuantDense
+    layout: each layer ships ~half the bytes, logits track the bf16
+    stream, and generation still works (int8 ZeRO-Inference — the
+    streamed analog of the engine's dtype=int8 tier)."""
+    cfg, model, params = _model_and_params(family="llama", n_layer=3)
+    host = jax.device_get(params)
+    ids = jnp.asarray(np.random.default_rng(7)
+                      .integers(0, 64, (2, 10)).astype(np.int32))
+
+    ref_eng = ZeroInferenceEngine(cfg, host, dtype=jnp.float32)
+    q_eng = ZeroInferenceEngine(cfg, host, dtype=jnp.float32, int8=True)
+
+    # per-layer wire bytes drop close to half (scales/norms keep f32)
+    assert sum(q_eng._leaf_nbytes) < 0.7 * sum(ref_eng._leaf_nbytes)
+
+    ref = np.asarray(ref_eng(ids), np.float32)
+    got = np.asarray(q_eng(ids), np.float32)
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree > 0.9, agree
+
+    toks = q_eng.generate(ids, max_new_tokens=4)
+    assert toks.shape == (2, 14)
