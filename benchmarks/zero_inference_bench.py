@@ -64,6 +64,81 @@ def build_host_params(model, cfg, ids, std=0.01):
     return jax.tree_util.tree_map_with_path(fill, shapes)
 
 
+def start_heartbeat():
+    """Keep-alive transfers: the tunneled host->device link cold-starts
+    after idle gaps (a 5 s pause costs ~30 s on the next stream). Returns
+    the Event that stops the thread."""
+    import threading
+
+    stop_beat = threading.Event()
+    beat_buf = np.ones(64 * 1024, np.int8)
+
+    def _heartbeat():
+        while not stop_beat.is_set():
+            jax.device_put(beat_buf).block_until_ready()
+            stop_beat.wait(0.05)
+
+    threading.Thread(target=_heartbeat, daemon=True).start()
+    return stop_beat
+
+
+def compare_int8(cfg, host, ids, n_params):
+    """A/B/A: bf16 stream, int8 stream, bf16 again (order effects on the
+    tunneled link are real); one instrumented pass each, readbacks last.
+
+    RSS budget (pathology #1: staged bytes are retained per pass): params
+    + 0.5x int8 copy + 2x bf16 passes + 1x int8 pass ≈ 4.5x the bf16
+    model bytes — size --params-b so that fits host RAM (≤4B here)."""
+    from deepspeed_tpu.inference.zero_inference import ZeroInferenceEngine
+
+    stop_beat = start_heartbeat()
+
+    engines = {
+        "bf16": ZeroInferenceEngine(cfg, host, prefetch=1),
+        "int8": ZeroInferenceEngine(cfg, host, prefetch=1, int8=True),
+    }
+    rows = {}
+    logits = {}
+    wire_bytes = {}
+    for name in ("bf16", "int8", "bf16_again"):
+        eng = engines[name.split("_")[0]]
+        times = []
+        t0 = time.perf_counter()
+        logits[name] = eng.forward(ids, layer_times=times)
+        logits[name].block_until_ready()
+        wire = sum(eng._leaf_nbytes) * eng.n_layer
+        wire_bytes[name] = wire
+        best = sorted(times[1:])[:max(1, (len(times) - 1) // 2)]
+        rows[name] = {
+            "pass_s": round(time.perf_counter() - t0, 2),
+            "wire_gb": round(wire / 1e9, 2),
+            "layer_times_s": [round(t, 3) for t in times],
+            "best_half_layers_gbps": round(
+                (wire / eng.n_layer) * len(best) / sum(best) / 1e9, 3),
+        }
+        print(name, rows[name]["pass_s"], "s,", rows[name]["wire_gb"],
+              "GB wire", flush=True)
+    stop_beat.set()
+    ll = {n: engines[n.split("_")[0]].score_logits(logits[n], ids)
+          for n in logits}
+    agree = float(np.mean(np.asarray(logits["bf16"], np.float32).argmax(-1) ==
+                          np.asarray(logits["int8"], np.float32).argmax(-1)))
+    result = {
+        "kind": "int8_stream_compare",
+        "params_b": n_params / 1e9,
+        "rows": rows,
+        "argmax_agreement": agree,
+        "mean_loglik": {n: float(np.mean(v)) for n, v in ll.items()},
+        "wire_ratio": wire_bytes["int8"] / wire_bytes["bf16"],
+        "backend": jax.default_backend(),
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "int8_stream_results.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--params-b", type=float, default=32.0,
@@ -71,6 +146,9 @@ def main():
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--compare-int8", action="store_true",
+                    help="A/B/A: bf16 stream vs int8-at-rest stream "
+                         "(half the wire bytes) on the same model")
     args = ap.parse_args()
 
     from deepspeed_tpu.inference.zero_inference import ZeroInferenceEngine
@@ -99,24 +177,14 @@ def main():
     print(f"built {n_params/1e9:.2f}B params ({total_bytes/1e9:.1f} GB "
           f"host-resident) in {time.perf_counter()-t0:.0f}s", flush=True)
 
+    if args.compare_int8:
+        return compare_int8(cfg, host, ids, n_params)
+
     engine = ZeroInferenceEngine(cfg, host, dtype=jnp.bfloat16, prefetch=1)
     stream_bytes = sum(np.asarray(l).nbytes for l in
                        jax.tree_util.tree_leaves(host["blocks"]["block"]))
 
-    # keep-alive heartbeat: the tunneled host->device link cold-starts
-    # after idle gaps (measured: a 5 s pause costs ~30 s on the next
-    # stream); tiny periodic transfers keep it in the warm state across
-    # compile/build/score-tail gaps
-    import threading
-    stop_beat = threading.Event()
-    beat_buf = np.ones(64 * 1024, np.int8)
-
-    def _heartbeat():
-        while not stop_beat.is_set():
-            jax.device_put(beat_buf).block_until_ready()
-            stop_beat.wait(0.05)
-
-    threading.Thread(target=_heartbeat, daemon=True).start()
+    stop_beat = start_heartbeat()
 
     # Two axon-tunnel pathologies constrain the measurement protocol
     # (both absent on directly-attached TPUs):
