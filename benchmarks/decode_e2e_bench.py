@@ -1,0 +1,71 @@
+"""End-to-end generation delta from the fused Pallas decode kernel.
+
+A/B/A on a 1B llama-family model: `decode_kernel="off"` vs `"auto"`
+vs `"off"` again (order effects on the shared chip are real), whole-loop
+compiled generate(), 8x128 new tokens against a 1024-slot cache. This is
+the system-level complement to the kernel microbench in attn_bench.py:
+generation decodes almost entirely at live length << capacity, the
+regime the kernel's DMA clamp targets. Writes decode_e2e_results.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer_lm import (
+        TransformerLM,
+        transformer_config,
+    )
+
+    rows = {}
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, 32000, (8, 16)), jnp.int32)
+    params = None
+    for mode in ("off", "auto", "off_again"):
+        cfg = transformer_config(
+            "llama", vocab_size=32000, n_embd=1536, n_layer=24, n_head=16,
+            max_seq_len=1024, decode_kernel=mode.split("_")[0])
+        model = TransformerLM(cfg)
+        if params is None:
+            params = model.init({"params": jax.random.PRNGKey(0)}, ids,
+                                method=model.logits)["params"]
+        eng = deepspeed_tpu.init_inference(model, model_parameters=params,
+                                           dtype="bfloat16")
+        eng.generate(ids, max_new_tokens=128)  # compile + warm
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            eng.generate(ids, max_new_tokens=128)
+            times.append(time.perf_counter() - t0)
+        rows[mode] = {"tokens_per_s": round(128 * 8 / min(times), 1),
+                      "times": [round(t, 2) for t in times]}
+        print(mode, rows[mode], flush=True)
+        del eng
+
+    result = {
+        "kind": "decode_kernel_e2e", "model": "1.0B llama 24Lx1536",
+        "batch": 8, "new_tokens": 128, "cache_len": 1024, "rows": rows,
+        "speedup_auto_vs_off": round(
+            rows["auto"]["tokens_per_s"] /
+            max(rows["off"]["tokens_per_s"],
+                rows["off_again"]["tokens_per_s"]), 3),
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "decode_e2e_results.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
