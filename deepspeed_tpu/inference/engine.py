@@ -170,8 +170,13 @@ class InferenceEngine:
 
         from ..ops.quantization.convert import quantize_lm_params
 
+        # the vocab projection stays full precision (int8_head defaults
+        # off) — same tier shape as ZeroInferenceEngine, so dtype=int8
+        # yields identical output-head numerics in both engines
+        head_keys = {"lm_head"} if not getattr(
+            self.module.config, "int8_head", False) else set()
         qparams, n_dense = quantize_lm_params(
-            params, dense_keys=self._INT8_DENSE_KEYS)
+            params, dense_keys=self._INT8_DENSE_KEYS - head_keys)
         self._serve_module = self.module.clone(config=dataclasses.replace(
             self.module.config, int8_weights=True))
         log_dist(f"inference int8 compute tier: {n_dense} Dense kernels -> "

@@ -51,14 +51,16 @@ class ZeroInferenceEngine:
             # int8 ZeRO-Inference: quantize the Dense kernels host-side
             # (QuantDense layout) so each streamed layer is ~half the
             # bytes AND the dequant runs inside the Pallas GEMM on chip.
-            # The head stays in the always-resident tier, so it is left
-            # unquantized (head_fn consumes a plain kernel).
+            # The head lives in the always-resident tier and stays full
+            # precision unless ``config.int8_head`` opts it in (same tier
+            # shape as the resident engine); head_fn dequantizes it.
             import dataclasses
 
             from ..ops.quantization.convert import DENSE_KEYS, quantize_lm_params
 
+            head_keys = set() if config.int8_head else {"lm_head"}
             params_host, n_dense = quantize_lm_params(
-                params_host, dense_keys=DENSE_KEYS - {"lm_head"})
+                params_host, dense_keys=DENSE_KEYS - head_keys)
             config = dataclasses.replace(config, int8_weights=True)
             log_dist(f"ZeroInference int8 tier: {n_dense} Dense kernels -> "
                      "QuantDense (streamed int8-at-rest)", ranks=[0])
@@ -171,8 +173,13 @@ class ZeroInferenceEngine:
             ln = _norm(cfg, "ln_f")
             x = ln.apply({"params": ln_f_params}, x)
             if lm_head is not None:
-                return x.astype(jnp.float32) @ \
-                    lm_head["kernel"].astype(jnp.float32)
+                kern = lm_head["kernel"].astype(jnp.float32)
+                if "scale" in lm_head:
+                    # int8_head tier: QuantDense layout (padded int8 kernel
+                    # + per-column scale); dequant on the resident copy and
+                    # slice off the lane padding
+                    kern = (kern * lm_head["scale"])[:, :cfg.vocab_size]
+                return x.astype(jnp.float32) @ kern
             return x.astype(jnp.float32) @ \
                 emb["embedding"].T.astype(jnp.float32)
 

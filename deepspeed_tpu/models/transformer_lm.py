@@ -66,10 +66,16 @@ class TransformerConfig:
     layer_norm_epsilon: float = 1e-5
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
+    use_flash_attention: Any = "auto"   # True | False | "auto" (Pallas flash
+    # for the full-context forward on TPU from the tuned crossover length;
+    # alibi and train-mode attention dropout stay on the einsum path)
     remat: bool = False
     decode_kernel: str = "auto"         # auto | on | off (fused Pallas decode)
     int8_weights: bool = False          # serve with int8-at-rest Dense kernels
     int8_kernel: str = "auto"           # auto | on | off (Pallas dequant-GEMM)
+    int8_head: bool = False             # quantize lm_head too (off: the vocab
+    # projection — the largest single accuracy lever — stays full precision,
+    # matching the ZeRO-Inference streamed tier and reference practice)
 
     @property
     def head_dim(self) -> int:
@@ -192,6 +198,30 @@ class CachedAttention(nn.Module):
 
     config: TransformerConfig
 
+    def _use_flash(self, seq_len: int, deterministic: bool) -> bool:
+        """Route the full-context (non-decode) forward through the Pallas
+        flash kernel. ``auto``: on TPU from the tuned crossover length;
+        ``True`` forces it (interpret mode off-TPU — for tests). ALiBi has
+        no flash bias hook and attention-probability dropout has no kernel
+        equivalent — those stay on the einsum path (forcing raises)."""
+        cfg = self.config
+        use = cfg.use_flash_attention
+        if use is False or use == "off":
+            return False
+        alibi_ok = cfg.pos_emb != "alibi"
+        drop_ok = cfg.dropout == 0 or deterministic
+        if use == "auto":
+            from ..ops.attention.flash_attention import use_flash_by_default
+
+            return use_flash_by_default(seq_len) and alibi_ok and drop_ok
+        if not alibi_ok:
+            raise ValueError("use_flash_attention=True does not compose with "
+                             "pos_emb='alibi' (no bias hook in the kernel)")
+        if not drop_ok:
+            raise ValueError("use_flash_attention=True does not support "
+                             "attention-probability dropout in train mode")
+        return True
+
     def _use_decode_kernel(self, cache_len: int,
                            deterministic: bool = True) -> bool:
         """Route 1-token decode through the fused Pallas kernel. ``auto``:
@@ -272,6 +302,21 @@ class CachedAttention(nn.Module):
             # row t may see cache slots [0, start+t]
             mask = (jnp.arange(S)[None, :] <= (start + jnp.arange(T))[:, None])
         else:
+            if self._use_flash(T, deterministic):
+                # fused Pallas flash attention for the full-context forward
+                # (and, via its custom_vjp, the streamed/resident backward) —
+                # O(T) memory instead of the (B, H, T, T) logits tensor
+                from ..ops.attention.flash_attention import flash_attention
+
+                k_f, v_f = k, v
+                if KV != H:
+                    k_f = jnp.repeat(k, H // KV, axis=2)
+                    v_f = jnp.repeat(v, H // KV, axis=2)
+                y = flash_attention(q.astype(cfg.dtype),
+                                    k_f.astype(cfg.dtype),
+                                    v_f.astype(cfg.dtype), causal=True)
+                y = y.astype(cfg.dtype).reshape(B, T, H * D)
+                return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
             k_all = k.transpose(0, 2, 1, 3)  # (B, KV, T, D)
             v_all = v.transpose(0, 2, 1, 3)
             S = T
@@ -376,7 +421,9 @@ class TransformerLM(nn.Module):
         )(cfg, name="blocks")
         self.ln_f = _norm(cfg, "ln_f")
         if not cfg.tie_word_embeddings:
-            self.lm_head = _dense(cfg, cfg.vocab_size, use_bias=False,
+            head_cfg = cfg if (cfg.int8_head or not cfg.int8_weights) else \
+                dataclasses.replace(cfg, int8_weights=False)
+            self.lm_head = _dense(head_cfg, cfg.vocab_size, use_bias=False,
                                   dtype=jnp.float32, name="lm_head")
 
     def _transform(self, input_ids, positions, decode, deterministic):

@@ -42,9 +42,42 @@ DEFAULT_BLOCK_M = 128
 DEFAULT_BLOCK_N = 512
 DEFAULT_BLOCK_K = 1024
 
+# VMEM the kernel may claim: ~16 MB/core on current TPUs; leave headroom
+# for Mosaic's own staging. Shapes whose tile plan exceeds this run the
+# jnp reference instead of failing to compile at serve time.
+VMEM_BUDGET_BYTES = 10 * 1024 * 1024
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _plan_vmem_bytes(bm: int, bk: int, bn: int) -> int:
+    """Worst-case VMEM for one grid step: double-buffered inputs (x bf16;
+    w int8 plus its in-kernel bf16 expansion; scale row), f32 accumulator
+    scratch and the output tile."""
+    inputs = bm * bk * 2 + bk * bn * (1 + 2) + bn * 4
+    return 2 * inputs + bm * bn * (4 + 2)
+
+
+def kernel_plan(M: int, K: int, N: int, block_m: int = DEFAULT_BLOCK_M,
+                block_n: int = DEFAULT_BLOCK_N,
+                block_k: int = DEFAULT_BLOCK_K,
+                vmem_budget: Optional[int] = VMEM_BUDGET_BYTES):
+    """Tile plan (bm, bk, bn) for the Pallas kernel, or ``None`` when the
+    shape should take the jnp reference: untileable K/N, or a plan (e.g.
+    the full-dimension fallback for non-128-multiple dims) whose operand
+    tiles would blow the VMEM budget. ``vmem_budget=None`` skips the
+    budget gate (interpret mode has no VMEM)."""
+    bk = _pick_block(block_k, K)
+    bn = _pick_block(block_n, N)
+    if bk == 0 or bn == 0:
+        return None
+    bm = min(block_m, max(8, -(-M // 8) * 8))
+    if vmem_budget is not None and \
+            _plan_vmem_bytes(bm, bk, bn) > vmem_budget:
+        return None
+    return bm, bk, bn
 
 
 def _pick_block(limit: int, n: int, full_cap: int = 4096) -> int:
@@ -144,17 +177,26 @@ def int8_matmul(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
 
     x: (..., K) floating; w: (K, N) int8; scales: (N,) or (1, N) f32
     per-output-channel. Returns (..., N) in ``out_dtype``. Shapes whose
-    K/N can't satisfy the tiling rules run the jnp reference instead.
+    K/N can't satisfy the tiling rules (or whose plan exceeds the VMEM
+    budget) run the jnp reference instead. Off-TPU the reference runs
+    unless the caller forces the kernel with ``interpret=True``
+    (kernel_mode='on' test forcing) — interpret-mode Pallas is orders of
+    magnitude slower than the jnp formulation.
     """
+    forced = interpret is True
     if interpret is None:
-        interpret = _interpret()
+        if _interpret():
+            return int8_matmul_reference(x, w, scales, out_dtype)
+        interpret = False
     K, N = w.shape
-    bk = _pick_block(block_k, K)
-    bn = _pick_block(block_n, N)
-    if bk == 0 or bn == 0:
-        return int8_matmul_reference(x, w, scales, out_dtype)
     batch_shape = x.shape[:-1]
     x2 = x.reshape(-1, K)
+    # forced interpret mode has no VMEM: only untileable K/N bail there
+    plan = kernel_plan(x2.shape[0], K, N, block_m, block_n, block_k,
+                       vmem_budget=None if forced else VMEM_BUDGET_BYTES)
+    if plan is None:
+        return int8_matmul_reference(x, w, scales, out_dtype)
+    _, bk, bn = plan
     y = _int8_matmul_2d(x2, w, scales, out_dtype=jnp.dtype(out_dtype),
                         block_m=block_m, block_n=bn, block_k=bk,
                         interpret=interpret)

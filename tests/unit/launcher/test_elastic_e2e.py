@@ -125,6 +125,16 @@ if mode == "train" and rank == 0:
 """
 
 
+def _free_port() -> int:
+    """An ephemeral port from the OS — fixed ports collide under parallel
+    test execution (xdist / concurrent CI jobs on one host)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _launch(script, work, mode, total, kill_at, nprocs, port, elastic=False):
     cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.runner",
            "--num_gpus", str(nprocs), "--master_port", str(port)]
@@ -145,7 +155,7 @@ def test_elastic_loop_end_to_end(tmp_path):
 
     # Phase A: 2 workers, elastic agent on; rank 1 dies after step 2 on the
     # first attempt; the agent restarts and training resumes to step 4.
-    proc = _launch(str(script), work, "train", 4, 2, nprocs=2, port=29531,
+    proc = _launch(str(script), work, "train", 4, 2, nprocs=2, port=_free_port(),
                    elastic=True)
     assert proc.returncode == 0, proc.stderr[-4000:]
 
@@ -167,7 +177,7 @@ def test_elastic_loop_end_to_end(tmp_path):
 
     # Phase B: relaunch at world size 1 from the universal checkpoint.
     proc = _launch(str(script), work, "resume_universal", 6, -1, nprocs=1,
-                   port=29532)
+                   port=_free_port())
     assert proc.returncode == 0, proc.stderr[-4000:]
 
     probe_b = json.loads((tmp_path / "probe_after_remesh.json").read_text())
