@@ -30,6 +30,7 @@ import numpy as np
 
 from ..models.transformer_lm import TransformerBlock, TransformerConfig
 from ..utils.logging import log_dist
+from ..utils.streaming import LayerWireFormat
 
 
 def _slice_layer(stacked: Any, i: int) -> Any:
@@ -76,27 +77,20 @@ class ZeroInferenceEngine:
         # many small leaves; leaves are re-sliced on device by a jitted
         # unpack (an HBM-local copy)
         self.pack = pack
-        leaves_wp, self._layer_treedef = \
-            jax.tree_util.tree_flatten_with_path(_slice_layer(self._stacked, 0))
         # the packed buffer is raw BYTES, so any leaf-dtype mix ships as
         # one transfer (bf16 checkpoints, int8 QuantDense kernels with
         # f32 scales, ...). Float leaves are converted to the engine
         # compute dtype at stage time — except "scale" leaves, which are
-        # per-channel quantization/norm scales that stay full precision.
-        # (jnp.issubdtype, not np: ml_dtypes bfloat16 is not an
-        # np.floating subtype.)
-        def wire_dtype(path, leaf):
-            d = np.asarray(leaf).dtype
-            if not jnp.issubdtype(d, jnp.floating):
-                return d
-            if getattr(path[-1], "key", None) == "scale":
-                return d
-            return np.dtype(dtype)
-
-        self._leaf_shapes = [np.shape(l) for _, l in leaves_wp]
-        self._leaf_wire_dtypes = [wire_dtype(p, l) for p, l in leaves_wp]
-        self._leaf_nbytes = [int(np.prod(s)) * d.itemsize for s, d in
-                             zip(self._leaf_shapes, self._leaf_wire_dtypes)]
+        # per-channel quantization/norm scales that stay full precision
+        # (utils/streaming.py holds the shared wire format).
+        self._wire = LayerWireFormat(
+            _slice_layer(self._stacked, 0), dtype,
+            keep_dtype=lambda path, leaf:
+            getattr(path[-1], "key", None) == "scale")
+        self._layer_treedef = self._wire.treedef
+        self._leaf_shapes = self._wire.shapes
+        self._leaf_wire_dtypes = self._wire.wire_dtypes
+        self._leaf_nbytes = self._wire.nbytes
 
         # small always-resident pieces: embeddings, final norm, head
         def put_small(name):
@@ -234,12 +228,8 @@ class ZeroInferenceEngine:
                 except AttributeError:
                     break  # runtime without is_ready: keep refs as guards
         buf = self._staging[slot]
-        offs = 0
-        for leaf, wdt, nb in zip(leaves, self._leaf_wire_dtypes,
-                                 self._leaf_nbytes):
-            flat_leaf = np.asarray(leaf, wdt).reshape(-1).view(np.uint8)
-            buf[offs:offs + nb] = flat_leaf
-            offs += nb
+        self._wire.pack_into(
+            jax.tree_util.tree_unflatten(self._layer_treedef, leaves), buf)
         # CPU backend: device_put ZERO-COPIES host numpy, so a reused
         # staging buffer would alias a live device array — hand it a
         # private copy there (tests-only path; real accelerators copy on
@@ -251,19 +241,7 @@ class ZeroInferenceEngine:
 
     def _unpack(self, flat):
         """Traced: packed byte buffer -> leaf tree (HBM-local bitcasts)."""
-        offs, leaves = 0, []
-        for shape, wdt, nb in zip(self._leaf_shapes, self._leaf_wire_dtypes,
-                                  self._leaf_nbytes):
-            seg = flat[offs:offs + nb]
-            jdt = jnp.dtype(wdt)
-            if jdt.itemsize > 1:
-                seg = jax.lax.bitcast_convert_type(
-                    seg.reshape(-1, jdt.itemsize), jdt)
-            else:
-                seg = jax.lax.bitcast_convert_type(seg, jdt)
-            leaves.append(seg.reshape(shape))
-            offs += nb
-        return jax.tree_util.tree_unflatten(self._layer_treedef, leaves)
+        return self._wire.unpack(flat)
 
     def forward(self, input_ids, layer_times: Optional[list] = None
                 ) -> jnp.ndarray:

@@ -141,6 +141,19 @@ class DeepSpeedEngine:
         self._offload_opt = None
         self._jit_offload_grads = None
         self._jit_offload_apply = None
+        # parameter offload (ZeRO-Infinity param tier): params live on
+        # host/NVMe and STREAM through the chip per layer — the training
+        # path is zero/param_offload.py, which subsumes the optimizer
+        # offload (host Adam is inherent to it)
+        op = self.zero_config.offload_param
+        self._param_offload_enabled = op is not None and \
+            op.device != OffloadDeviceEnum.none
+        self._param_offload = None
+        if self._param_offload_enabled:
+            from .zero import param_offload as _po
+
+            self._offload_enabled = False  # subsumed by the streaming path
+            _po.check_supported(self)
         if self._offload_enabled:
             opt_type = (self._config.optimizer.type
                         if self._config.optimizer else "adam").lower()
@@ -158,6 +171,7 @@ class DeepSpeedEngine:
         # front so misconfigurations fail at initialize(), not first step
         from .fp16.onebit import wire as onebit_wire
         self._onebit_wire = (not self._offload_enabled
+                             and not self._param_offload_enabled
                              and onebit_wire.is_enabled(self._config, self.mesh))
         if self._onebit_wire:
             onebit_wire.check_supported(self)
@@ -371,6 +385,31 @@ class DeepSpeedEngine:
     def _build_state(self, params_host) -> None:
         mesh = self.mesh
         policy = self.policy
+
+        if self._param_offload_enabled:
+            # streamed param-offload path: params never become device
+            # state; the runner owns the store + host optimizer
+            from .zero.param_offload import ParamOffloadRunner
+
+            self._param_offload = ParamOffloadRunner(self, params_host)
+            self._offload_opt = self._param_offload.opt
+            self.state = {
+                # params stay in the runner's host/NVMe store; checkpoint
+                # paths materialize them on demand (full_params_tree)
+                "params": None,
+                "master": None, "opt_state": None,
+                "step": jnp.asarray(0, jnp.int32),
+                "opt_step": jnp.asarray(0, jnp.int32),
+                "scale": None,
+                "rng": jax.random.PRNGKey(self._rng_seed + 1),
+            }
+            self._shardings = None
+            self._num_params = count_parameters(params_host)
+            self._last_grad_norm = None
+            log_dist(f"engine state built (param offload): "
+                     f"{self._num_params / 1e6:.1f}M params streamed",
+                     ranks=[0])
+            return
 
         # compute-dtype cast, except for obviously-integer leaves
         def cast(p):
@@ -689,6 +728,9 @@ class DeepSpeedEngine:
                 return x.reshape((gas, global_micro) + x.shape[1:])
 
             stacked = jax.tree_util.tree_map(reshape, batch_or_iter)
+        if self._param_offload_enabled:
+            # streamed path slices micro batches host-side; no device put
+            return jax.tree_util.tree_map(np.asarray, stacked)
         # micro dim (1) shards over the batch axes; scan dim (0) replicated;
         # sequence dim (2) over `seq` when sequence parallelism is on
         return jax.tree_util.tree_map(
@@ -722,7 +764,15 @@ class DeepSpeedEngine:
         self._maybe_profile_flops(stacked)
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
-        if self._offload_enabled:
+        if self._param_offload is not None:
+            # streamed path: feed host micro batches (gas-major)
+            micros = [jax.tree_util.tree_map(lambda x, i=i: np.asarray(x[i]),
+                                             stacked)
+                      for i in range(self.gradient_accumulation_steps())]
+            metrics = self._param_offload.train_batch(micros)
+            self.state["step"] = self.state["step"] + 1
+            self.state["opt_step"] = self.state["opt_step"] + 1
+        elif self._offload_enabled:
             self.state, grads_dev, metrics = self._jit_offload_grads(
                 self.state, stacked)
             self._host_optimizer_step(grads_dev, metrics)
@@ -750,9 +800,10 @@ class DeepSpeedEngine:
             {"step": self.global_steps,
              "gas": self.gradient_accumulation_steps()}, "step/gas counters")
         dist.assert_same_across_ranks(stacked, "batch structure")
-        dist.assert_same_across_ranks(
-            jax.tree_util.tree_structure(self.state["params"]).__repr__(),
-            "param tree structure")
+        if self.state.get("params") is not None:
+            dist.assert_same_across_ranks(
+                jax.tree_util.tree_structure(self.state["params"]).__repr__(),
+                "param tree structure")
 
     def _apply_curriculum(self, stacked):
         """Truncate the sequence dim to the current curriculum difficulty
@@ -792,7 +843,8 @@ class DeepSpeedEngine:
         engine.py:1688,1705 flops_profiler hooks."""
         fp = self._config.flops_profiler
         if not fp.enabled or self.global_steps != fp.profile_step \
-                or getattr(self, "_flops_profiled", False):
+                or getattr(self, "_flops_profiled", False) \
+                or self._param_offload is not None:
             return
         self._flops_profiled = True  # once, even with gas>1 eager forwards
         from ..profiling.flops_profiler import FlopsProfiler
@@ -900,6 +952,11 @@ class DeepSpeedEngine:
     def forward(self, batch):
         """Compute loss (grads stashed for backward) — reference
         engine.forward (engine.py:1675)."""
+        if self._param_offload_enabled:
+            raise RuntimeError(
+                "the eager forward()/backward()/step() API does not compose "
+                "with offload_param streaming (params are never "
+                "device-resident) — drive training with train_batch()")
         if self.state is None:
             self._build_state(self._init_params_from_batch(batch))
         self._maybe_profile_flops(
@@ -977,6 +1034,9 @@ class DeepSpeedEngine:
             "_state_dict is the single-host path; multi-host saves go " \
             "through the orbax engine (save_checkpoint dispatches)"
         host = jax.device_get(self.state)
+        if self._param_offload is not None:
+            # params live in the runner's host/NVMe store
+            host["params"] = self._param_offload.full_params_tree()
         sd = {
             "module": fser.to_state_dict(host["params"]),
             "master": fser.to_state_dict(host["master"]) if host["master"] is not None
@@ -1047,8 +1107,14 @@ class DeepSpeedEngine:
             OrbaxCheckpointEngine,
         )
 
-        use_orbax = dist.get_world_size() > 1 or \
-            isinstance(self.checkpoint_engine, OrbaxCheckpointEngine)
+        # param-offload: weights live in the runner's host/NVMe store, not
+        # in state["params"] — the orbax array path would silently drop
+        # them; the single-host npz path materializes via _state_dict
+        # (param offload is single-process, enforced at initialize())
+        use_orbax = (dist.get_world_size() > 1 or
+                     isinstance(self.checkpoint_engine,
+                                OrbaxCheckpointEngine)) and \
+            self._param_offload is None
         if use_orbax:
             # orbax writes each process's addressable shards in parallel
             # (multi-host requirement; also the nebula/async engine path)
@@ -1108,6 +1174,28 @@ class DeepSpeedEngine:
         new_state = dict(self.state)
 
         fp32 = univ["fp32"]
+        if self._param_offload is not None:
+            template = self._param_offload.full_params_tree()
+            restored = fser.from_state_dict(template, fp32)
+            self._param_offload.load_params(jax.tree_util.tree_map(
+                lambda m, p: np.asarray(m).astype(np.asarray(p).dtype),
+                restored, template))
+            self._offload_opt.load_universal(restored, univ["opt"])
+            meta = univ["meta"]
+            new_state["step"] = jnp.asarray(meta.get("step", 0), jnp.int32)
+            new_state["opt_step"] = jnp.asarray(
+                meta.get("opt_step", meta.get("step", 0)), jnp.int32)
+            self.global_steps = meta.get("global_steps", 0)
+            self.global_samples = meta.get("global_samples", 0)
+            self.micro_steps = meta.get("micro_steps", 0)
+            self.skipped_steps = meta.get("skipped_steps", 0)
+            if self.lr_scheduler is not None and meta.get("lr_scheduler") \
+                    and hasattr(self.lr_scheduler, "load_state_dict"):
+                self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+            self.state = new_state
+            log_dist(f"loaded universal checkpoint {load_dir}/{tag} "
+                     "(param-offload store)", ranks=[0])
+            return load_dir, {}
         if host["master"] is not None:
             restored_master = fser.from_state_dict(host["master"], fp32)
             new_state["master"] = jax.device_put(
@@ -1178,14 +1266,21 @@ class DeepSpeedEngine:
             "engine state not built yet — run or init params before load_checkpoint"
 
         host = jax.device_get(self.state)
+        if self._param_offload is not None:
+            host["params"] = self._param_offload.full_params_tree()
 
         def restore(target, saved):
             return fser.from_state_dict(target, saved)
 
         new_state = dict(self.state)
         restored_params = restore(host["params"], sd["module"])
-        new_state["params"] = jax.device_put(
-            restored_params, self._shardings["params"])
+        if self._param_offload is not None:
+            # install into the streaming store; no device-resident params
+            self._param_offload.load_params(restored_params)
+            new_state["params"] = None
+        else:
+            new_state["params"] = jax.device_put(
+                restored_params, self._shardings["params"])
         if self._offload_opt is not None and (
                 load_module_only or not load_optimizer_states
                 or sd.get("offload_optimizer") is None):

@@ -70,25 +70,11 @@ class OffloadedOptimizer:
         log_dist(f"ZeRO-Offload optimizer: device={config.device} "
                  f"native_adam={self.opt.native}", ranks=[0])
 
-        flat = _flatten_with_paths(params_host)
-        self._template = params_host
-        self.master: Dict[str, Optional[np.ndarray]] = {}
-        self.m: Dict[str, Optional[np.ndarray]] = {}
-        self.v: Dict[str, Optional[np.ndarray]] = {}
-        self._shapes: Dict[str, tuple] = {}
-        self._float: Dict[str, bool] = {}
-        for p, leaf in flat.items():
-            a = np.asarray(leaf)
-            self._shapes[p] = a.shape
-            self._float[p] = np.issubdtype(a.dtype, np.floating) or \
-                str(a.dtype) == "bfloat16"
-            if self._float[p]:
-                self.master[p] = np.ascontiguousarray(a, np.float32)
-                self.m[p] = np.zeros(a.size, np.float32)
-                self.v[p] = np.zeros(a.size, np.float32)
-            else:
-                self.master[p] = np.asarray(a)  # integer leaf: passthrough
-
+        # nvme tier: optionally keep the fp32 master DRAM-resident and swap
+        # only the moments (offload_config.swap_master=False) — moments are
+        # 2/3 of the optimizer bytes and the master is what every other
+        # subsystem (checkpoint, debug APIs) touches most
+        self.swap_master = bool(getattr(config, "swap_master", True))
         self._aio = None
         if self.nvme:
             from ...ops.aio import AioHandle
@@ -121,7 +107,35 @@ class OffloadedOptimizer:
                 o_direct=use_od,
                 single_submit=ac.single_submit if ac else False,
                 overlap_events=ac.overlap_events if ac else True)
-            self._swap_out_all()
+
+        flat = _flatten_with_paths(params_host)
+        self._template = params_host
+        self.master: Dict[str, Optional[np.ndarray]] = {}
+        self.m: Dict[str, Optional[np.ndarray]] = {}
+        self.v: Dict[str, Optional[np.ndarray]] = {}
+        self._shapes: Dict[str, tuple] = {}
+        self._float: Dict[str, bool] = {}
+        for p, leaf in flat.items():
+            a = np.asarray(leaf)
+            self._shapes[p] = a.shape
+            self._float[p] = np.issubdtype(a.dtype, np.floating) or \
+                str(a.dtype) == "bfloat16"
+            if not self._float[p]:
+                self.master[p] = np.asarray(a)  # integer leaf: passthrough
+                continue
+            self.master[p] = np.ascontiguousarray(a, np.float32)
+            self.m[p] = np.zeros(a.size, np.float32)
+            self.v[p] = np.zeros(a.size, np.float32)
+            if self.nvme:
+                # swap THIS leaf out before touching the next one: peak
+                # transient host RAM stays O(largest leaf), not O(model)
+                # (zero-moment init of a 10B-class model would otherwise
+                # commit the full fp32 m+v before the first write)
+                self._submit_leaf_swap_out(p)
+                self._aio.wait()
+                self.m[p] = self.v[p] = None
+                if self.swap_master:
+                    self.master[p] = None
 
     # --- nvme swap ------------------------------------------------------
     def _leaf_file(self, p: str, kind: str) -> str:
@@ -129,18 +143,21 @@ class OffloadedOptimizer:
         return os.path.join(self.nvme_dir, f"{safe}.{kind}.bin")
 
     def _submit_leaf_swap_out(self, p: str) -> None:
-        """Queue one leaf's m/v/master writes (layout: moments raveled 1-D,
-        master raveled from its shape). Caller drains with _aio.wait()."""
+        """Queue one leaf's m/v (and, when ``swap_master``, master) writes
+        (layout: moments raveled 1-D, master raveled from its shape).
+        Caller drains with _aio.wait()."""
         self._aio.async_pwrite(self.m[p], self._leaf_file(p, "m"))
         self._aio.async_pwrite(self.v[p], self._leaf_file(p, "v"))
-        self._aio.async_pwrite(self.master[p].ravel(),
-                               self._leaf_file(p, "master"))
+        if self.swap_master:
+            self._aio.async_pwrite(self.master[p].ravel(),
+                                   self._leaf_file(p, "master"))
 
     def _drop_stores(self) -> None:
         for p in self.m:
             if self._float[p]:
                 self.m[p] = self.v[p] = None
-                self.master[p] = None
+                if self.swap_master:
+                    self.master[p] = None
 
     def _swap_out_all(self) -> None:
         for p in list(self.m):
@@ -173,14 +190,15 @@ class OffloadedOptimizer:
             n = int(np.prod(shape)) if shape else 1
             self.m[p] = self._alloc(n)
             self.v[p] = self._alloc(n)
-            self.master[p] = self._alloc(n).reshape(shape)
             tickets[p] = [
                 self._aio.async_pread(self.m[p], self._leaf_file(p, "m")),
                 self._aio.async_pread(self.v[p], self._leaf_file(p, "v")),
-                self._aio.async_pread(
-                    self.master[p].reshape(-1) if shape else
-                    self.master[p].ravel(), self._leaf_file(p, "master")),
             ]
+            if self.swap_master:
+                self.master[p] = self._alloc(n).reshape(shape)
+                tickets[p].append(self._aio.async_pread(
+                    self.master[p].reshape(-1) if shape else
+                    self.master[p].ravel(), self._leaf_file(p, "master")))
         return tickets
 
     def _swap_in_all(self) -> None:
@@ -195,12 +213,13 @@ class OffloadedOptimizer:
             n = int(np.prod(shape)) if shape else 1
             self.m[p] = self._alloc(n)
             self.v[p] = self._alloc(n)
-            self.master[p] = self._alloc(n).reshape(shape)
             self._aio.async_pread(self.m[p], self._leaf_file(p, "m"))
             self._aio.async_pread(self.v[p], self._leaf_file(p, "v"))
-            self._aio.async_pread(self.master[p].reshape(-1) if shape else
-                                  self.master[p].ravel(),
-                                  self._leaf_file(p, "master"))
+            if self.swap_master:
+                self.master[p] = self._alloc(n).reshape(shape)
+                self._aio.async_pread(self.master[p].reshape(-1) if shape
+                                      else self.master[p].ravel(),
+                                      self._leaf_file(p, "master"))
         self._aio.wait()
 
     def read_leaf(self, kind: str, key: str) -> Optional[np.ndarray]:
@@ -237,10 +256,17 @@ class OffloadedOptimizer:
         return True
 
     # --- step -----------------------------------------------------------
-    def step(self, grads_host, lr: float, step_num: int, compute_dtype):
+    def step(self, grads_host, lr: float, step_num: int, compute_dtype,
+             grad_scale: float = 1.0, release_grads: bool = False):
         """Apply one host Adam step. ``grads_host``: pytree of fp32 numpy
-        (already unscaled/clipped). Returns the new compute-dtype param
-        pytree (host arrays, ready for device_put). ``step_num`` 1-indexed.
+        (already unscaled/clipped, or scaled here via ``grad_scale`` —
+        applied in the per-leaf contiguous copy, so deferred clip/averaging
+        costs no extra pass). ``release_grads`` drops each leaf's grad
+        reference the moment its update finishes — with the caller's own
+        references dropped, peak host RAM falls as the step progresses
+        (the streamed param-offload path hands over ~param-sized fp32
+        buffers). Returns the new compute-dtype param pytree (host arrays,
+        ready for device_put). ``step_num`` 1-indexed.
 
         NVMe tier pipelining (≅ PipelinedOptimizerSwapper): ALL leaves'
         swap-in reads are submitted up front and the compute loop waits
@@ -277,8 +303,17 @@ class OffloadedOptimizer:
                         self._aio.wait_ticket(t)
                     del tickets[p]
                     master = self.master[p]
-                g = np.ascontiguousarray(
-                    np.asarray(grads[p], np.float32)).ravel()
+                g = np.asarray(grads[p], np.float32)
+                if grad_scale != 1.0:
+                    g = g * np.float32(grad_scale)
+                g = np.ascontiguousarray(g).ravel()
+                if release_grads:
+                    # progressive release needs the CALLER's container to
+                    # drop its ref too — effective when grads_host is the
+                    # flat {path: array} dict the streaming path hands over
+                    grads[p] = None
+                    if isinstance(grads_host, dict) and p in grads_host:
+                        grads_host[p] = None
                 self.opt.step(
                     master.reshape(-1) if master.shape else master.ravel(),
                     g, self.m[p], self.v[p], step_num, lr=lr)
@@ -307,7 +342,8 @@ class OffloadedOptimizer:
                 # of treating garbage as authoritative in-memory state
                 for p in tickets:
                     self.m[p] = self.v[p] = None
-                    self.master[p] = None
+                    if self.swap_master:
+                        self.master[p] = None
                 try:
                     self._aio.wait()
                 except IOError as io_err:

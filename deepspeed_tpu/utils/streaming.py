@@ -1,0 +1,83 @@
+"""Shared byte-packing for layer streaming (ZeRO-Inference + param offload).
+
+One transformer layer's param tree travels host↔device as ONE contiguous
+byte buffer: per-transfer latency (host↔device link round-trips) would
+otherwise dominate the stream for trees with many small leaves. Leaves are
+re-sliced on device by a traced bitcast unpack (an HBM-local copy).
+
+Used by ``inference/zero_inference.py`` (serving stream) and
+``runtime/zero/param_offload.py`` (training stream) — the wire-dtype rule,
+packing and unpack must stay byte-identical between them, which is why
+they live here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LayerWireFormat:
+    """Leaf metadata + pack/unpack for one layer's param tree.
+
+    ``keep_dtype`` (path, leaf) -> bool: leaves that keep their storage
+    dtype on the wire (e.g. quantization "scale" rows); every other float
+    leaf converts to ``compute_dtype``; non-floats always keep storage.
+    """
+
+    def __init__(self, layer_tree, compute_dtype,
+                 keep_dtype: Optional[Callable] = None):
+        self.compute_dtype = np.dtype(compute_dtype)
+        leaves_wp, self.treedef = \
+            jax.tree_util.tree_flatten_with_path(layer_tree)
+
+        def wire_dtype(path, leaf):
+            d = np.asarray(leaf).dtype
+            if not jnp.issubdtype(d, jnp.floating):
+                return d
+            if keep_dtype is not None and keep_dtype(path, leaf):
+                return d
+            return self.compute_dtype
+
+        self.shapes: List[tuple] = [np.shape(l) for _, l in leaves_wp]
+        self.wire_dtypes = [wire_dtype(p, l) for p, l in leaves_wp]
+        self.nbytes = [int(np.prod(s)) * d.itemsize
+                       for s, d in zip(self.shapes, self.wire_dtypes)]
+        self.total_nbytes = sum(self.nbytes)
+
+    def pack_into(self, layer_tree, buf: np.ndarray) -> None:
+        """Host: flatten + convert + concatenate into ``buf`` (uint8)."""
+        leaves = jax.tree_util.tree_leaves(layer_tree)
+        offs = 0
+        for leaf, wdt, nb in zip(leaves, self.wire_dtypes, self.nbytes):
+            buf[offs:offs + nb] = \
+                np.asarray(leaf, wdt).reshape(-1).view(np.uint8)
+            offs += nb
+
+    def unpack(self, flat):
+        """Traced: packed byte buffer -> leaf tree (HBM-local bitcasts)."""
+        offs, leaves = 0, []
+        for shape, wdt, nb in zip(self.shapes, self.wire_dtypes,
+                                  self.nbytes):
+            seg = flat[offs:offs + nb]
+            jdt = jnp.dtype(wdt)
+            if jdt.itemsize > 1:
+                seg = jax.lax.bitcast_convert_type(
+                    seg.reshape(-1, jdt.itemsize), jdt)
+            else:
+                seg = jax.lax.bitcast_convert_type(seg, jdt)
+            leaves.append(seg.reshape(shape))
+            offs += nb
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def unpack_host(self, buf: np.ndarray):
+        """Host-side inverse of :meth:`pack_into` (checkpoint reads)."""
+        offs, out = 0, []
+        for shape, wdt, nb in zip(self.shapes, self.wire_dtypes,
+                                  self.nbytes):
+            out.append(buf[offs:offs + nb].view(wdt).reshape(shape).copy())
+            offs += nb
+        return jax.tree_util.tree_unflatten(self.treedef, out)
