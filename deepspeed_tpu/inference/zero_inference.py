@@ -234,13 +234,21 @@ class ZeroInferenceEngine:
         # staging buffer would alias a live device array — hand it a
         # private copy there (tests-only path; real accelerators copy on
         # transfer and keep the rotating-buffer RSS/pinning wins)
+        uni = self._wire.uniform_dtype
+        if uni is not None:
+            # dtype-uniform layer (plain bf16 checkpoints): ship TYPED and
+            # unpack by slice+reshape — the byte-path's (N, itemsize)
+            # bitcast reshape tiles catastrophically on real TPUs
+            buf = buf.view(uni)
         payload = buf.copy() if jax.default_backend() == "cpu" else buf
         dev = jax.device_put(payload)
         self._staging_dev[slot] = dev
         return dev
 
     def _unpack(self, flat):
-        """Traced: packed byte buffer -> leaf tree (HBM-local bitcasts)."""
+        """Traced: packed buffer -> leaf tree (HBM-local)."""
+        if self._wire.uniform_dtype is not None:
+            return self._wire.unpack_typed(flat)
         return self._wire.unpack(flat)
 
     def forward(self, input_ids, layer_times: Optional[list] = None

@@ -116,3 +116,157 @@ class MMapIndexedDataset:
     def exists(prefix: str) -> bool:
         return os.path.exists(index_file_path(prefix)) and \
             os.path.exists(data_file_path(prefix))
+
+
+# ---------------------------------------------------------------------------
+# Megatron-LM mmap format interop
+# ---------------------------------------------------------------------------
+# Byte-compatible reader/writer for the layout the reference ships
+# (``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py``,
+# MMapIndexedDataset.Index): existing Megatron-preprocessed corpora are
+# consumed directly — curriculum/analyzer tooling does not require a
+# re-encode. Layout: ``.idx`` = magic 'MMIDIDX\x00\x00' + u64 version(=1)
+# + u8 dtype code + u64 n_seqs + u64 n_docs + i32 sizes[n] + i64
+# pointers[n] (byte offsets) + i64 doc_idx[n_docs]; ``.bin`` = the raw
+# concatenated token arrays.
+
+MEGATRON_MAGIC = b"MMIDIDX\x00\x00"
+
+_MEGATRON_DTYPES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+    6: np.float64, 7: np.double, 8: np.uint16, 9: np.uint32, 10: np.uint64,
+}
+# Newer readers accept codes 9/10, but Megatron-LM's and the reference's
+# own tables stop at 8 — the WRITER emits only codes both sides read, or
+# the 'readable by the reference' claim breaks with a remote KeyError.
+_MEGATRON_WRITABLE_CODES = {np.dtype(v): k
+                            for k, v in _MEGATRON_DTYPES.items() if k <= 8}
+
+
+class MegatronMMapIndexedDataset:
+    """Zero-copy reader for the Megatron-LM / reference mmap layout.
+
+    Same access surface as :class:`MMapIndexedDataset` (``__getitem__``,
+    ``get``, ``sizes``, ``dtype``) plus ``doc_idx`` (document boundaries,
+    which the native layout does not track).
+    """
+
+    def __init__(self, prefix: str, skip_warmup: bool = True):
+        self._prefix = prefix
+        path = index_file_path(prefix)
+        with open(path, "rb") as f:
+            magic = f.read(9)
+            assert magic == MEGATRON_MAGIC, \
+                f"{path} is not a Megatron-format index"
+            (version,) = struct.unpack("<Q", f.read(8))
+            assert version == 1, f"unsupported Megatron index v{version}"
+            (code,) = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(_MEGATRON_DTYPES[code])
+            (self._count,) = struct.unpack("<Q", f.read(8))
+            (self._doc_count,) = struct.unpack("<Q", f.read(8))
+            header = f.tell()
+        n = self._count
+        self._sizes = np.memmap(path, dtype=np.int32, mode="r",
+                                offset=header, shape=(n,))
+        self._pointers = np.memmap(path, dtype=np.int64, mode="r",
+                                   offset=header + 4 * n, shape=(n,))
+        self._doc_idx = np.memmap(path, dtype=np.int64, mode="r",
+                                  offset=header + 4 * n + 8 * n,
+                                  shape=(self._doc_count,))
+        self._data = np.memmap(data_file_path(prefix), dtype=self._dtype,
+                               mode="r")
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        offset = int(self._pointers[idx]) // self._dtype.itemsize
+        length = int(self._sizes[idx])
+        return np.asarray(self._data[offset:offset + length])
+
+    def get(self, idx: int, offset: int = 0, length: int = None):
+        base = int(self._pointers[idx]) // self._dtype.itemsize + offset
+        if length is None:
+            length = int(self._sizes[idx]) - offset
+        return np.asarray(self._data[base:base + length])
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return np.asarray(self._sizes)
+
+    @property
+    def doc_idx(self) -> np.ndarray:
+        return np.asarray(self._doc_idx)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        path = index_file_path(prefix)
+        if not (os.path.exists(path) and
+                os.path.exists(data_file_path(prefix))):
+            return False
+        with open(path, "rb") as f:
+            return f.read(9) == MEGATRON_MAGIC
+
+
+class MegatronMMapIndexedDatasetBuilder:
+    """Writer emitting the reference's byte layout (corpus export /
+    fixtures readable by Megatron-LM and the reference itself)."""
+
+    def __init__(self, out_file_prefix: str, dtype=np.int32):
+        self._prefix = out_file_prefix
+        self._dtype = np.dtype(dtype)
+        if self._dtype not in _MEGATRON_WRITABLE_CODES:
+            raise ValueError(
+                f"dtype {self._dtype} has no Megatron-LM dtype code "
+                "(reference readers know codes 1-8: u8/i8/i16/i32/i64/"
+                "f64/double/u16) — use the native MMapIndexedDatasetBuilder")
+        self._data_file = open(data_file_path(out_file_prefix), "wb")
+        self._sizes: List[int] = []
+        self._doc_idx: List[int] = [0]
+
+    def add_item(self, array: Sequence) -> None:
+        arr = np.asarray(array, dtype=self._dtype)
+        self._data_file.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def finalize(self) -> None:
+        self._data_file.close()
+        sizes = np.asarray(self._sizes, dtype=np.int32)
+        pointers = np.zeros(len(sizes), dtype=np.int64)
+        if len(sizes) > 1:
+            np.cumsum(sizes[:-1].astype(np.int64) * self._dtype.itemsize,
+                      out=pointers[1:])
+        if self._doc_idx[-1] != len(self._sizes):
+            self.end_document()
+        doc_idx = np.asarray(self._doc_idx, dtype=np.int64)
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(MEGATRON_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", _MEGATRON_WRITABLE_CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(doc_idx.tobytes(order="C"))
+
+
+def load_indexed_dataset(prefix: str, skip_warmup: bool = True):
+    """Open ``prefix``.bin/.idx in WHICHEVER layout it carries — native
+    (DSTPUIDX) or Megatron (MMIDIDX) — by sniffing the index magic, the
+    reference's ``infer_dataset_impl`` behavior."""
+    with open(index_file_path(prefix), "rb") as f:
+        magic = f.read(9)
+    if magic == MEGATRON_MAGIC:
+        return MegatronMMapIndexedDataset(prefix, skip_warmup=skip_warmup)
+    if magic[:len(_MAGIC)] == _MAGIC:
+        return MMapIndexedDataset(prefix, skip_warmup=skip_warmup)
+    raise ValueError(f"{prefix}.idx: unrecognized index magic {magic!r}")

@@ -434,10 +434,16 @@ class DeepSpeedEngine:
             master = None
             opt_state = None
         else:
+            keep_master = self._keep_master
+            if self._onebit_wire and int(self.zero_config.stage) >= 1:
+                # stage-1 onebit: the fp32 master lives SHARDED as
+                # master_flat inside the onebit state (wire.py) — a
+                # replicated pytree master would defeat ZeRO-1's memory
+                keep_master = False
             master = jax.tree_util.tree_map(
                 lambda p: jnp.asarray(p, jnp.float32) if jnp.issubdtype(
                     jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
-                params_host) if self._keep_master else None
+                params_host) if keep_master else None
             opt_state = None if self._onebit_wire else \
                 self.optimizer_def.init(master if master is not None else params)
 
@@ -1045,6 +1051,10 @@ class DeepSpeedEngine:
             if host["opt_state"] is not None else None,
             "offload_optimizer": self._offload_opt.state_dict()
             if self._offload_opt is not None else None,
+            # onebit wire: momentum + error buffers (+ stage-1 sharded
+            # master) — without these a resume would re-zero the exchange
+            "onebit": fser.to_state_dict(host["onebit"])
+            if host.get("onebit") is not None else None,
             "step": int(host["step"]),
             "opt_step": int(host["opt_step"]),
             "scale": fser.to_state_dict(host["scale"]) if host["scale"] is not None
@@ -1081,6 +1091,7 @@ class DeepSpeedEngine:
             "scale": fser.to_state_dict(self.state["scale"])
             if self.state["scale"] is not None else None,
             "rng": self.state["rng"],
+            "onebit": self.state.get("onebit"),
         }
         arrays = {k: v for k, v in arrays.items() if v is not None}
         meta = {
@@ -1299,6 +1310,11 @@ class DeepSpeedEngine:
             if load_optimizer_states and self._offload_opt is not None \
                     and sd.get("offload_optimizer") is not None:
                 self._offload_opt.load_state_dict(sd["offload_optimizer"])
+            if load_optimizer_states and sd.get("onebit") is not None \
+                    and self.state.get("onebit") is not None:
+                new_state["onebit"] = jax.device_put(
+                    fser.from_state_dict(host["onebit"], sd["onebit"]),
+                    self._shardings["onebit"])
             new_state["step"] = jnp.asarray(sd["step"], jnp.int32)
             new_state["opt_step"] = jnp.asarray(sd.get("opt_step", sd["step"]), jnp.int32)
             if sd.get("scale") is not None and host["scale"] is not None:
@@ -1357,6 +1373,9 @@ class DeepSpeedEngine:
             for key in ("step", "opt_step", "rng"):
                 if key in restored:
                     new_state[key] = restored[key]
+            if load_optimizer_states and "onebit" in restored and \
+                    self.state.get("onebit") is not None:
+                new_state["onebit"] = restored["onebit"]
             if "scale" in restored and self.state["scale"] is not None:
                 new_state["scale"] = fser.from_state_dict(
                     self.state["scale"], restored["scale"])

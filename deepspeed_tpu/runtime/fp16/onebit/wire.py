@@ -12,22 +12,26 @@ runs under ``shard_map`` over the data axis, gradients stay RANK-LOCAL
   gradient — the reference's uncompressed warmup phase;
 * compression stage: each rank folds its LOCAL gradient into the momentum
   and the momentum crosses the wire through
-  ``runtime/comm/compressed.compressed_allreduce`` — int8 signs + fp32
-  per-chunk scales via all_to_all + all_gather, with persistent per-rank
-  worker/server error feedback. The variance is frozen, exactly as the
-  dynamics-only path freezes it.
+  ``runtime/comm/compressed.compressed_allreduce`` — BIT-PACKED uint8
+  signs (8 signs/byte, the true 1-bit wire format; ``onebit_packing:
+  "int8"`` keeps the one-sign-per-byte fallback) + fp32 per-chunk scales
+  via all_to_all + all_gather, with persistent per-rank worker/server
+  error feedback. The variance is frozen, exactly as the dynamics-only
+  path freezes it.
 
 Per-step logical wire volume (returned in metrics as ``comm_bytes``; the
-test suite asserts the drop and that the int8 collectives exist in HLO):
-dense ring-allreduce moves ~2·4·N·(w-1)/w ≈ 8N bytes/rank; the compressed
-exchange moves N int8 (all_to_all) + N int8 (all_gather) + scales ≈ 2N —
-the ~4x reduction the reference claims for its compression phase (16x is
-its 1-bit-packed wire format; XLA's narrowest collective dtype is int8).
+test suite asserts the drop and that the packed collectives exist in
+HLO): dense ring-allreduce moves ~2·4·N·(w-1)/w ≈ 8N bytes/rank; the
+packed exchange moves N/8 uint8 (all_to_all) + N/8 uint8 (all_gather) +
+scales ≈ N/4 — a ~32x reduction, matching the shape of the reference's
+packed compression-phase claim (nccl.py:54-130).
 
 Scope (mirrors the reference's own constraints for 1-bit optimizers):
-pure data parallelism (mp = sp = pp = 1), ZeRO stage 0/1 semantics with a
-replicated fp32 master, bf16 compute (no dynamic loss scale), no gradient
-clipping in the compression stage.
+pure data parallelism (mp = sp = pp = 1), ZeRO stage 0 (replicated fp32
+master) or stage 1 — stage 1 shards v + the fp32 master over the data
+axis as ``onebit["v"]``/``onebit["master_flat"]`` rows and re-gathers
+bf16 params each step (no replicated master exists); bf16 compute (no
+dynamic loss scale), no gradient clipping in the compression stage.
 """
 
 from __future__ import annotations
@@ -80,12 +84,13 @@ def check_supported(engine) -> None:
         raise ValueError("comm_backend_name=compressed requires bf16 "
                          "compute (the flat exchange needs the separate "
                          "fp32 master that only non-fp32 compute keeps)")
-    if engine.zero_optimization_stage() > 0:
-        raise ValueError("comm_backend_name=compressed requires ZeRO stage "
-                         "0: the flat momentum exchange needs the replicated "
-                         "fp32 master (stage >= 1 shards it over the data "
-                         "axis; the reference's 1-bit optimizers are "
-                         "similarly restricted to ZeRO <= 1)")
+    if engine.zero_optimization_stage() > 1:
+        raise ValueError("comm_backend_name=compressed supports ZeRO stage "
+                         "0 or 1 (stage 1 shards v + fp32 master over the "
+                         "data axis and re-gathers bf16 params; stage >= 2 "
+                         "shards gradients, which the rank-local exchange "
+                         "cannot see — the reference's 1-bit optimizers are "
+                         "likewise restricted to ZeRO <= 1)")
     opt_params = dict(engine._config.optimizer.params or {})
     if opt_params.get("weight_decay", 0.0) and \
             not opt_params.get("adam_w_mode", True):
@@ -93,16 +98,26 @@ def check_supported(engine) -> None:
                          "weight decay only (classic mode folds decay into "
                          "the gradient, which the compression stage never "
                          "sees after the exchange)")
+    if opt_params.get("onebit_packing", "1bit") not in ("1bit", "int8"):
+        raise ValueError("onebit_packing must be '1bit' (packed uint8, "
+                         "8 signs/byte) or 'int8' (fallback)")
 
 
 def build_onebit_state(engine, params):
     """Extra engine-state entry: flat fp32 (m, v) + per-rank error buffers.
 
-    Global shapes: m/v (N,) replicated; worker error (world, N) and server
-    error (world, N // world) sharded over the data axis — each rank
-    persists only its own row.
+    Global shapes: m (N,) replicated — the algorithm folds each rank's
+    LOCAL gradient into the FULL momentum, so m cannot shard; worker
+    error (world, N) and server error (world, N // world) sharded over
+    the data axis — each rank persists only its own row.
+
+    ZeRO stage 1 additionally shards what CAN shard: v (frozen in the
+    compression stage) and the fp32 master both live as (world, N/world)
+    rows; the update runs per shard and bf16 params are re-gathered —
+    the reference's "1-bit Adam with ZeRO-1" memory/wire tradeoff.
     """
     world = engine.dp_world_size
+    stage1 = engine.zero_optimization_stage() >= 1
     flat, _ = jax.flatten_util.ravel_pytree(
         jax.tree_util.tree_map(lambda p: jnp.zeros(np.shape(p), jnp.float32),
                                params))
@@ -113,12 +128,25 @@ def build_onebit_state(engine, params):
     ranked = NamedSharding(mesh, P(mesh_mod.DATA_AXIS))
     state = {
         "m": jax.device_put(jnp.zeros((n_pad,), jnp.float32), rep),
-        "v": jax.device_put(jnp.zeros((n_pad,), jnp.float32), rep),
         "we": jax.device_put(jnp.zeros((world, n_pad), jnp.float32), ranked),
         "se": jax.device_put(jnp.zeros((world, n_pad // world), jnp.float32),
                              ranked),
     }
-    shardings = {"m": rep, "v": rep, "we": ranked, "se": ranked}
+    shardings = {"m": rep, "we": ranked, "se": ranked}
+    if stage1:
+        master_flat = jnp.pad(jax.flatten_util.ravel_pytree(
+            jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, jnp.float32), params))[0],
+            (0, n_pad - n))
+        state["v"] = jax.device_put(
+            jnp.zeros((world, n_pad // world), jnp.float32), ranked)
+        state["master_flat"] = jax.device_put(
+            master_flat.reshape(world, n_pad // world), ranked)
+        shardings["v"] = ranked
+        shardings["master_flat"] = ranked
+    else:
+        state["v"] = jax.device_put(jnp.zeros((n_pad,), jnp.float32), rep)
+        shardings["v"] = rep
     return state, shardings
 
 
@@ -142,18 +170,26 @@ def build_train_step(engine):
     weight_decay = opt_params.get("weight_decay", 0.0)
     freeze_step = opt_params.get("freeze_step", 100000)
     adam_w_mode = opt_params.get("adam_w_mode", True)
+    packing = opt_params.get("onebit_packing", "1bit")
+    stage1 = engine.zero_optimization_stage() >= 1
 
-    sample_master = engine.state["master"]
-    flat0, unravel = jax.flatten_util.ravel_pytree(sample_master)
+    sample = engine.state["master"] if engine.state["master"] is not None \
+        else engine.state["params"]
+    flat0, unravel = jax.flatten_util.ravel_pytree(sample)
     n = flat0.shape[0]
     n_pad = engine.state["onebit"]["m"].shape[0]
 
-    # logical wire volume per rank per step (bytes) — see module docstring
-    dense_bytes = 2 * 4 * n_pad * (world - 1) // world
-    comp_bytes = (n_pad                      # all_to_all int8 signs
+    # logical wire volume per rank per step (bytes) — see module docstring.
+    # 1-bit packing ships 8 signs/byte (uint8); int8 fallback 1 sign/byte.
+    sign_bytes = n_pad // 8 if packing == "1bit" else n_pad
+    # stage 1 re-gathers the updated bf16 params (sharded master)
+    param_gather_bytes = 2 * n_pad * (world - 1) // world if stage1 else 0
+    dense_bytes = 2 * 4 * n_pad * (world - 1) // world + param_gather_bytes
+    comp_bytes = (sign_bytes                 # all_to_all packed signs
                   + 4 * world                # all_to_all scales
-                  + n_pad                    # all_gather int8 signs
-                  + 4 * world)               # all_gather scales
+                  + sign_bytes               # all_gather packed signs
+                  + 4 * world                # all_gather scales
+                  + param_gather_bytes)
 
     def local_step(state, onebit, stacked_batch):
         """Runs per-rank inside shard_map: batch leaves carry the LOCAL
@@ -184,10 +220,13 @@ def build_train_step(engine):
             jax.tree_util.tree_map(lambda g: g / gas, grads_sum))[0]
         g_local = jnp.pad(g_local, (0, n_pad - n))
 
-        m, v = onebit["m"], onebit["v"]
+        m = onebit["m"]
+        v = onebit["v"][0] if stage1 else onebit["v"]  # stage1: my row
         we = onebit["we"][0]          # this rank's rows
         se = onebit["se"][0]
         t = state["opt_step"].astype(jnp.float32) + 1.0
+        chunk = n_pad // world
+        rank = jax.lax.axis_index(axis)
 
         def warmup(_):
             g = jax.lax.pmean(g_local, axis)
@@ -195,43 +234,70 @@ def build_train_step(engine):
                 norm = jnp.sqrt(jnp.sum(g * g))
                 g = g * jnp.minimum(1.0, clip / (norm + 1e-6))
             m_new = beta1 * m + (1.0 - beta1) * g
-            v_new = beta2 * v + (1.0 - beta2) * g * g
+            if stage1:
+                g_sq = jax.lax.dynamic_slice(g, (rank * chunk,), (chunk,))
+                v_new = beta2 * v + (1.0 - beta2) * g_sq * g_sq
+            else:
+                v_new = beta2 * v + (1.0 - beta2) * g * g
             return m_new, v_new, we, se, jnp.asarray(dense_bytes, jnp.float32)
 
         def compressed(_):
             # fold the LOCAL gradient into the momentum; the exchange
-            # averages momenta across ranks (int8 on the wire)
+            # averages momenta across ranks (bit-packed uint8 on the wire)
             m_local = beta1 * m + (1.0 - beta1) * g_local
             m_new, we_new, se_new = compressed_allreduce(
-                m_local, we, se, axis_name=axis)
+                m_local, we, se, axis_name=axis, packing=packing)
             return m_new, v, we_new, se_new, \
                 jnp.asarray(comp_bytes, jnp.float32)
 
         m_new, v_new, we_new, se_new, wire = jax.lax.cond(
             t > freeze_step, compressed, warmup, operand=None)
 
-        # AdamW update on the replicated fp32 master
         bc1 = 1.0 - beta1 ** t
         bc2 = 1.0 - beta2 ** t
-        master_flat = jnp.pad(
-            jax.flatten_util.ravel_pytree(state["master"])[0], (0, n_pad - n))
-        denom = jnp.sqrt(v_new / bc2) + eps
-        update = (m_new / bc1) / denom
         lr = lr_fn(state["step"])
-        new_flat = master_flat - lr * update
-        if weight_decay != 0.0 and adam_w_mode:
-            new_flat = new_flat - lr * weight_decay * master_flat
-        new_master = unravel(new_flat[:n])
-        new_params = jax.tree_util.tree_map(
-            lambda mp, p: mp.astype(p.dtype), new_master, params)
-
         new_state = dict(state)
+        if stage1:
+            # sharded update: my (v, master) rows + my chunk of the full
+            # momentum; bf16 params re-gathered (ZeRO-1's allgather)
+            master_chunk = onebit["master_flat"][0]
+            m_chunk = jax.lax.dynamic_slice(m_new, (rank * chunk,), (chunk,))
+            denom = jnp.sqrt(v_new / bc2) + eps
+            upd = (m_chunk / bc1) / denom
+            new_chunk = master_chunk - lr * upd
+            if weight_decay != 0.0 and adam_w_mode:
+                new_chunk = new_chunk - lr * weight_decay * master_chunk
+            gathered = jax.lax.all_gather(
+                new_chunk.astype(compute_dtype), axis).reshape(n_pad)
+            new_params = unravel(gathered[:n].astype(jnp.float32))
+            new_params = jax.tree_util.tree_map(
+                lambda np_, p: np_.astype(p.dtype), new_params, params)
+            new_master_flat = new_chunk[None]
+            new_state["master"] = None
+        else:
+            # AdamW update on the replicated fp32 master
+            master_flat = jnp.pad(
+                jax.flatten_util.ravel_pytree(state["master"])[0],
+                (0, n_pad - n))
+            denom = jnp.sqrt(v_new / bc2) + eps
+            update = (m_new / bc1) / denom
+            new_flat = master_flat - lr * update
+            if weight_decay != 0.0 and adam_w_mode:
+                new_flat = new_flat - lr * weight_decay * master_flat
+            new_master = unravel(new_flat[:n])
+            new_params = jax.tree_util.tree_map(
+                lambda mp, p: mp.astype(p.dtype), new_master, params)
+            new_state["master"] = new_master
+            new_master_flat = None
+
         new_state["params"] = new_params
-        new_state["master"] = new_master
         new_state["step"] = state["step"] + 1
         new_state["opt_step"] = state["opt_step"] + 1
-        new_onebit = {"m": m_new, "v": v_new, "we": we_new[None],
-                      "se": se_new[None]}
+        new_onebit = {"m": m_new,
+                      "v": v_new[None] if stage1 else v_new,
+                      "we": we_new[None], "se": se_new[None]}
+        if stage1:
+            new_onebit["master_flat"] = new_master_flat
         # RMS proxy for ||mean_r g_r||: exact when ranks hold identical
         # gradients, an upper bound otherwise — forming the true mean
         # would cost the dense allreduce the compression stage exists to
@@ -253,8 +319,11 @@ def build_train_step(engine):
         state = dict(state)
         onebit = state.pop("onebit")
         state_specs = spec_like(state, rep)
-        onebit_specs = {"m": rep, "v": rep,
-                        "we": P(axis, None), "se": P(axis, None)}
+        ranked = P(axis, None)
+        onebit_specs = {"m": rep, "v": ranked if stage1 else rep,
+                        "we": ranked, "se": ranked}
+        if stage1:
+            onebit_specs["master_flat"] = ranked
         bspecs = jax.tree_util.tree_map(lambda _: P(None, axis),
                                         stacked_batch)
         metric_specs = spec_like(

@@ -187,7 +187,12 @@ class LayerParamStore:
             self._aio.wait()
 
     def unpack(self, flat):
-        """Traced: packed byte buffer -> layer param tree (HBM bitcasts)."""
+        """Traced: packed buffer -> layer param tree. Training wires are
+        dtype-uniform, so the buffer ships TYPED and unpacks by
+        slice+reshape (see LayerWireFormat.uniform_dtype for why the byte
+        path is a real-TPU hazard)."""
+        if self.wire.uniform_dtype is not None:
+            return self.wire.unpack_typed(flat)
         return self.wire.unpack(flat)
 
     # -- streaming -----------------------------------------------------
@@ -244,6 +249,9 @@ class LayerParamStore:
                 except AttributeError:
                     break
         buf = self._staging[slot]
+        uni = self.wire.uniform_dtype
+        if uni is not None:
+            buf = buf.view(uni)  # zero-copy typed view of the staging bytes
         payload = buf.copy() if jax.default_backend() == "cpu" else buf
         dev = jax.device_put(payload)
         self._staging_dev[slot] = dev

@@ -412,3 +412,103 @@ class TestDataAnalyzer:
         # early curriculum: only short sequences eligible
         assert all(len(ds[i]) <= 8 for i in batch), \
             [len(ds[i]) for i in batch]
+
+
+class TestMegatronIndexedDataset:
+    """Interop with the reference's Megatron-LM mmap layout
+    (deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py:369
+    MMapIndexedDataset.Index, magic MMIDIDX): existing corpora are read
+    without re-encoding."""
+
+    @staticmethod
+    def _write_reference_layout(prefix, seqs, doc_idx, dtype=np.int32):
+        """Handwritten writer following the REFERENCE's byte layout (so the
+        test does not trust our own builder): magic + u64 version + u8
+        dtype code + u64 n + u64 docs + i32 sizes + i64 byte pointers +
+        i64 doc_idx; .bin = concatenated arrays."""
+        import struct
+
+        dtype = np.dtype(dtype)
+        code = {np.dtype(np.int32): 4, np.dtype(np.uint16): 8}[dtype]
+        with open(prefix + ".bin", "wb") as f:
+            for s in seqs:
+                f.write(np.asarray(s, dtype).tobytes())
+        sizes = np.asarray([len(s) for s in seqs], np.int32)
+        pointers = np.zeros(len(seqs), np.int64)
+        np.cumsum(sizes[:-1].astype(np.int64) * dtype.itemsize,
+                  out=pointers[1:])
+        with open(prefix + ".idx", "wb") as f:
+            f.write(b"MMIDIDX\x00\x00")
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", code))
+            f.write(struct.pack("<Q", len(seqs)))
+            f.write(struct.pack("<Q", len(doc_idx)))
+            f.write(sizes.tobytes())
+            f.write(pointers.tobytes())
+            f.write(np.asarray(doc_idx, np.int64).tobytes())
+
+    def test_reads_reference_layout(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+            MegatronMMapIndexedDataset,
+            load_indexed_dataset,
+        )
+
+        prefix = str(tmp_path / "corpus")
+        rng = np.random.default_rng(0)
+        seqs = [rng.integers(0, 50000, rng.integers(3, 40)).astype(np.int32)
+                for _ in range(17)]
+        doc_idx = [0, 5, 11, 17]
+        self._write_reference_layout(prefix, seqs, doc_idx)
+
+        ds = MegatronMMapIndexedDataset(prefix)
+        assert len(ds) == 17
+        assert ds.dtype == np.int32
+        for i, s in enumerate(seqs):
+            np.testing.assert_array_equal(ds[i], s)
+        np.testing.assert_array_equal(ds.sizes, [len(s) for s in seqs])
+        np.testing.assert_array_equal(ds.doc_idx, doc_idx)
+        # windowed access
+        np.testing.assert_array_equal(ds.get(3, offset=2, length=4),
+                                      seqs[3][2:6])
+        # magic sniffing dispatches to the Megatron reader
+        auto = load_indexed_dataset(prefix)
+        assert isinstance(auto, MegatronMMapIndexedDataset)
+
+    def test_builder_roundtrip_and_autodetect(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+            MegatronMMapIndexedDataset,
+            MegatronMMapIndexedDatasetBuilder,
+            MMapIndexedDataset,
+            MMapIndexedDatasetBuilder,
+            load_indexed_dataset,
+        )
+
+        rng = np.random.default_rng(1)
+        seqs = [rng.integers(0, 60000, 9).astype(np.uint16)
+                for _ in range(6)]
+
+        mprefix = str(tmp_path / "meg")
+        b = MegatronMMapIndexedDatasetBuilder(mprefix, dtype=np.uint16)
+        for i, s in enumerate(seqs):
+            b.add_item(s)
+            if i in (2, 4):
+                b.end_document()
+        b.finalize()
+        ds = MegatronMMapIndexedDataset(mprefix)
+        for i, s in enumerate(seqs):
+            np.testing.assert_array_equal(ds[i], s)
+        np.testing.assert_array_equal(ds.doc_idx, [0, 3, 5, 6])
+
+        # byte-level: our builder's index must parse as the handwritten
+        # reference layout does (same header fields)
+        raw = open(mprefix + ".idx", "rb").read()
+        assert raw[:9] == b"MMIDIDX\x00\x00"
+
+        nprefix = str(tmp_path / "native")
+        nb = MMapIndexedDatasetBuilder(nprefix, dtype=np.uint16)
+        for s in seqs:
+            nb.add_item(s)
+        nb.finalize()
+        assert isinstance(load_indexed_dataset(nprefix), MMapIndexedDataset)
+        assert MegatronMMapIndexedDataset.exists(mprefix)
+        assert not MegatronMMapIndexedDataset.exists(nprefix)

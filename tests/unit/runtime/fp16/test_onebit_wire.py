@@ -1,13 +1,16 @@
-"""1-bit Adam compressed exchange ON the wire (VERDICT r2 next #4).
+"""1-bit Adam compressed exchange ON the wire (VERDICT r2 next #4, r3 #6/#9).
 
-Three planes, all on the virtual 8-device mesh:
-  * volume accounting — metrics["comm_bytes"] must drop ~4x when the
-    compression stage starts (dense fp32 ring-allreduce vs int8
-    all_to_all + all_gather);
-  * HLO — the compiled step must CONTAIN s8 all-to-all/all-gather
-    collectives (fails if the compressed collective is bypassed);
+Four planes, all on the virtual 8-device mesh:
+  * volume accounting — metrics["comm_bytes"] must drop ~30x when the
+    compression stage starts (dense fp32 ring-allreduce vs BIT-PACKED
+    uint8 all_to_all + all_gather, 8 signs/byte; the int8 fallback
+    keeps the historical ~4x);
+  * HLO — the compiled step must CONTAIN u8 (packed) / s8 (fallback)
+    all-to-all/all-gather collectives;
   * convergence — training through the freeze boundary keeps improving,
-    and tracks the dynamics-only (GSPMD) OneBitAdam path.
+    and tracks the dynamics-only (GSPMD) OneBitAdam path;
+  * ZeRO stage 1 — sharded v + fp32 master with bf16 param re-gather
+    (the reference supports 1-bit Adam with ZeRO <= 1).
 """
 
 import jax
@@ -22,15 +25,17 @@ WORLD = 8
 FREEZE = 3
 
 
-def _config(freeze_step=FREEZE, backend="compressed", stage=0):
+def _config(freeze_step=FREEZE, backend="compressed", stage=0, packing=None):
+    params = {"lr": 1e-3, "freeze_step": freeze_step}
+    if backend:
+        params["comm_backend_name"] = backend
+    if packing:
+        params["onebit_packing"] = packing
     return {
         "train_micro_batch_size_per_gpu": 2,
         "gradient_accumulation_steps": 2,
         "zero_optimization": {"stage": stage},
-        "optimizer": {"type": "OneBitAdam",
-                      "params": {"lr": 1e-3, "freeze_step": freeze_step,
-                                 **({"comm_backend_name": backend}
-                                    if backend else {})}},
+        "optimizer": {"type": "OneBitAdam", "params": params},
         "bf16": {"enabled": True},
         "steps_per_print": 10 ** 9,
     }
@@ -47,8 +52,13 @@ def _batches(n, seed=0):
             for _ in range(n)]
 
 
-def test_comm_bytes_drop_at_freeze_boundary():
-    engine, _, _, _ = ds.initialize(model=_model(), config=_config())
+@pytest.mark.parametrize("packing,lo,hi", [
+    ("1bit", 20.0, 34.0),   # ~8N vs ~N/4: true bit-packed wire
+    ("int8", 3.0, 5.0),     # fallback: one sign per byte
+])
+def test_comm_bytes_drop_at_freeze_boundary(packing, lo, hi):
+    engine, _, _, _ = ds.initialize(model=_model(),
+                                    config=_config(packing=packing))
     dense, compressed = [], []
     for i, b in enumerate(_batches(6)):
         engine.train_batch(batch=b)
@@ -57,12 +67,14 @@ def test_comm_bytes_drop_at_freeze_boundary():
     assert all(v == dense[0] for v in dense)
     assert all(v == compressed[0] for v in compressed)
     ratio = dense[0] / compressed[0]
-    # dense ring allreduce ~8N vs int8 a2a+ag ~2N → ~4x (scales shave a hair)
-    assert 3.0 < ratio < 5.0, ratio
+    assert lo < ratio < hi, (packing, ratio)
 
 
-def test_compiled_step_contains_int8_collectives():
-    engine, _, _, _ = ds.initialize(model=_model(), config=_config())
+@pytest.mark.parametrize("packing,dtype_tag", [("1bit", "u8"),
+                                               ("int8", "s8")])
+def test_compiled_step_contains_packed_collectives(packing, dtype_tag):
+    engine, _, _, _ = ds.initialize(model=_model(),
+                                    config=_config(packing=packing))
     b = _batches(1)[0]
     stacked = engine._stack_micro_batches(b)
     if engine.state is None:
@@ -70,13 +82,14 @@ def test_compiled_step_contains_int8_collectives():
         engine._build_state(engine._init_params_from_batch(first))
     hlo = engine._jit_train_batch.lower(engine.state, stacked) \
         .compile().as_text()
-    # the compressed exchange must be present as int8 collectives — this
+    # the compressed exchange must be present as narrow collectives — this
     # fails if gradient exchange silently reverts to dense fp32 only
     assert "all-to-all" in hlo, "all_to_all collective missing from HLO"
-    s8_collective = any(
-        ("all-to-all" in line or "all-gather" in line) and "s8" in line
+    packed_collective = any(
+        ("all-to-all" in line or "all-gather" in line) and dtype_tag in line
         for line in hlo.splitlines())
-    assert s8_collective, "no int8 collective in the compiled step"
+    assert packed_collective, \
+        f"no {dtype_tag} collective in the compiled step"
 
 
 def test_convergence_through_freeze_boundary():
@@ -110,7 +123,62 @@ def test_state_has_per_rank_error_buffers():
 
 def test_rejected_configs():
     with pytest.raises(ValueError, match="ZeRO stage"):
-        ds.initialize(model=_model(), config=_config(stage=1))
+        ds.initialize(model=_model(), config=_config(stage=2))
+    with pytest.raises(ValueError, match="onebit_packing"):
+        ds.initialize(model=_model(), config=_config(packing="2bit"))
+
+
+def test_zero_stage1_sharded_state_and_convergence():
+    """Stage 1: v + fp32 master shard over the data axis (one row per
+    rank), params re-gather in bf16, and the trajectory still tracks the
+    stage-0 wire path through the freeze boundary."""
+    batches = _batches(12, seed=3)
+
+    def run(stage):
+        engine, _, _, _ = ds.initialize(
+            model=_model(), config=_config(freeze_step=4, stage=stage))
+        losses = [float(engine.train_batch(batch=b)) for b in batches]
+        return losses, engine
+
+    l1, eng1 = run(1)
+    l0, _ = run(0)
+    assert l1[-1] < l1[0]
+    assert abs(l1[-1] - l0[-1]) < 0.35, (l1[-1], l0[-1])
+
+    ob = eng1.state["onebit"]
+    n_pad = ob["m"].shape[0]
+    assert ob["v"].shape == (WORLD, n_pad // WORLD)
+    assert ob["master_flat"].shape == (WORLD, n_pad // WORLD)
+    assert ob["master_flat"].sharding.spec == \
+        jax.sharding.PartitionSpec("data")
+    assert eng1.state["master"] is None  # no replicated fp32 master
+
+    # stage-1 wire includes the bf16 param gather on top of the packed
+    # momentum exchange
+    vol1 = float(eng1._last_metrics["comm_bytes"])
+    n = n_pad
+    assert vol1 > 2 * n  # param gather dominates
+
+
+def test_onebit_checkpoint_roundtrip(tmp_path):
+    """Momentum + error buffers (and the stage-1 sharded master) survive
+    save/load — a resume must not silently re-zero the exchange."""
+    engine, _, _, _ = ds.initialize(model=_model(),
+                                    config=_config(stage=1))
+    batches = _batches(FREEZE + 2, seed=5)
+    for b in batches:
+        engine.train_batch(batch=b)
+    engine.save_checkpoint(str(tmp_path))
+    m_before = np.asarray(engine.state["onebit"]["m"])
+    l_next = float(engine.train_batch(batch=batches[0]))
+
+    eng2, _, _, _ = ds.initialize(model=_model(), config=_config(stage=1))
+    eng2.train_batch(batch=batches[0])  # build state
+    eng2.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(eng2.state["onebit"]["m"]),
+                               m_before, rtol=1e-6)
+    l_next2 = float(eng2.train_batch(batch=batches[0]))
+    assert abs(l_next - l_next2) < 5e-3, (l_next, l_next2)
 
 
 def test_compression_stage_actually_compresses():
