@@ -50,6 +50,12 @@ class GPT2Config:
     # sequence/context parallelism over the `seq` mesh axis:
     # None | "ring" (ppermute KV rotation) | "ulysses" (all-to-all head swap)
     sequence_parallel: Optional[str] = None
+    # block-sparse attention: a SparsityConfig (ops/sparse_attention) —
+    # every attention layer computes only the layout's blocks via the
+    # fused Pallas kernel (gather formulation off-TPU / fine granules).
+    # The model-level analog of the reference's SparseAttentionUtils
+    # module swap (module_inject; docs/_posts/2020-09-09-sparse-attention.md)
+    sparse_attention: Optional[Any] = None
 
 
 # sizes for the standard family
@@ -107,7 +113,36 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
 
-        if cfg.sequence_parallel:
+        if cfg.sparse_attention is not None:
+            if cfg.sequence_parallel:
+                raise ValueError("sparse_attention does not compose with "
+                                 "sequence_parallel (the layout is over the "
+                                 "full sequence)")
+            if cfg.dropout > 0 and not deterministic:
+                raise ValueError("sparse_attention does not support "
+                                 "attention-probability dropout")
+            import numpy as np
+
+            from ..ops.sparse_attention.pallas_kernel import (
+                block_sparse_flash_attention,
+                supports_pallas,
+            )
+
+            scfg = cfg.sparse_attention
+            layout = np.asarray(scfg.make_layout(T))
+            if supports_pallas(scfg.block, T) and \
+                    jax.default_backend() == "tpu":
+                y = block_sparse_flash_attention(
+                    q, k, v, layout, scfg.block, causal=True)
+            else:
+                # exact gather formulation (CPU tests / fine granules)
+                from ..ops.sparse_attention.sparse_self_attention import (
+                    block_sparse_attention,
+                )
+
+                y = block_sparse_attention(q, k, v, layout, scfg.block,
+                                           causal=True)
+        elif cfg.sequence_parallel:
             if cfg.sequence_parallel not in ("ring", "ulysses"):
                 raise ValueError(
                     f"sequence_parallel must be 'ring' or 'ulysses', "

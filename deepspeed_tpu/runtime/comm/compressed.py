@@ -88,10 +88,6 @@ def compressed_allreduce(
     """
     if packing not in ("1bit", "int8"):
         raise ValueError(f"packing must be '1bit' or 'int8', got {packing!r}")
-    if packing == "1bit" and x.shape[0] % 8 != 0:
-        raise ValueError(
-            f"packing='1bit' needs len(x) divisible by 8 (got "
-            f"{x.shape[0]}); pad the buffer or pass packing='int8'")
     pack = pack_signs if packing == "1bit" else (lambda s: s)
     unpack = unpack_signs if packing == "1bit" else (lambda s: s)
     if axis_name is None:
@@ -105,6 +101,13 @@ def compressed_allreduce(
     world = jax.lax.psum(1, axis_name)
     n = x.shape[0]
     chunk = n // world
+    if packing == "1bit" and chunk % 8 != 0:
+        # the PER-RANK chunk is what packs, so the contract is
+        # n % (8 * world) == 0, not n % 8
+        raise ValueError(
+            f"packing='1bit' needs the per-rank chunk divisible by 8 "
+            f"(n={n}, world={world} -> chunk={chunk}); pad the buffer to "
+            f"a multiple of 8*world or pass packing='int8'")
 
     # phase 1: local compression with worker error feedback
     c = x + worker_error

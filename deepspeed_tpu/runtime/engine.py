@@ -1237,6 +1237,14 @@ class DeepSpeedEngine:
                 fser.from_state_dict(host["opt_state"], merged),
                 self._shardings["opt_state"])
 
+        if self.state.get("onebit") is not None:
+            # universal files carry the fp32 master — exact reseed of the
+            # stage-1 sharded onebit master
+            from .fp16.onebit import wire as onebit_wire
+
+            new_state["onebit"] = onebit_wire.reseed_master_flat(
+                self, restored, self.state["onebit"])
+
         meta = univ["meta"]
         new_state["step"] = jnp.asarray(meta.get("step", 0), jnp.int32)
         new_state["opt_step"] = jnp.asarray(
@@ -1298,6 +1306,14 @@ class DeepSpeedEngine:
             # module-only restore under offload: re-seed the host master so
             # the next step doesn't overwrite the loaded weights
             self._offload_opt.sync_master_from(restored_params)
+        if self.state.get("onebit") is not None and (
+                load_module_only or not load_optimizer_states
+                or sd.get("onebit") is None):
+            # same hazard for the stage-1 onebit sharded master
+            from .fp16.onebit import wire as onebit_wire
+
+            new_state["onebit"] = onebit_wire.reseed_master_flat(
+                self, restored_params, self.state["onebit"])
         if not load_module_only:
             if sd.get("master") is not None and host["master"] is not None:
                 new_state["master"] = jax.device_put(
@@ -1387,6 +1403,14 @@ class DeepSpeedEngine:
                     and meta.get("lr_scheduler") is not None and \
                     hasattr(self.lr_scheduler, "load_state_dict"):
                 self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        if self.state.get("onebit") is not None and (
+                "onebit" not in restored or load_module_only
+                or not load_optimizer_states):
+            from .fp16.onebit import wire as onebit_wire
+
+            new_state["onebit"] = onebit_wire.reseed_master_flat(
+                self, jax.device_get(new_state["params"]),
+                new_state.get("onebit", self.state["onebit"]))
         self.state = new_state
         if self._offload_opt is not None:
             # restore this process's host optimizer state; without a file,

@@ -150,6 +150,28 @@ def build_onebit_state(engine, params):
     return state, shardings
 
 
+def reseed_master_flat(engine, params, onebit):
+    """Rebuild the stage-1 sharded fp32 master from externally-loaded
+    params. A PARTIAL checkpoint restore (module-only / no optimizer
+    states / pre-onebit checkpoint) would otherwise leave the init-time
+    ``master_flat`` in place and the next step would regenerate params
+    from it — silently discarding the loaded weights (the analog of
+    ``OffloadedOptimizer.sync_master_from``). No-op for stage 0 (the
+    replicated master pytree is restored through the normal path)."""
+    if onebit is None or "master_flat" not in onebit:
+        return onebit
+    world = engine.dp_world_size
+    n_pad = onebit["m"].shape[0]
+    flat = jax.flatten_util.ravel_pytree(jax.tree_util.tree_map(
+        lambda p: jnp.asarray(p, jnp.float32), params))[0]
+    flat = jnp.pad(flat, (0, n_pad - flat.shape[0]))
+    ranked = NamedSharding(engine.mesh, P(mesh_mod.DATA_AXIS))
+    new = dict(onebit)
+    new["master_flat"] = jax.device_put(
+        flat.reshape(world, n_pad // world), ranked)
+    return new
+
+
 def build_train_step(engine):
     """Compiled (state, stacked_batch) -> (state, metrics) with the
     shard_map'd compressed exchange. Plugs in as the engine's

@@ -180,6 +180,18 @@ def test_onebit_checkpoint_roundtrip(tmp_path):
     l_next2 = float(eng2.train_batch(batch=batches[0]))
     assert abs(l_next - l_next2) < 5e-3, (l_next, l_next2)
 
+    # PARTIAL restore (no optimizer states): the stage-1 sharded master
+    # must be re-seeded from the loaded weights — a stale init-time
+    # master would silently reset the model on the next step
+    eng3, _, _, _ = ds.initialize(model=_model(), config=_config(stage=1))
+    eng3.train_batch(batch=batches[0])  # build state
+    eng3.load_checkpoint(str(tmp_path), load_optimizer_states=False)
+    # the step loss is computed on the PRE-update params, so a correct
+    # restore reproduces the full-restore engine's loss exactly (a stale
+    # master would instead regenerate near-init params)
+    l3 = float(eng3.train_batch(batch=batches[0]))
+    assert abs(l3 - l_next) < 5e-3, (l3, l_next)
+
 
 def test_compression_stage_actually_compresses():
     """After freeze, worker error becomes non-zero (compression residual)."""
