@@ -1,0 +1,22 @@
+"""Shared benchmark plumbing."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache():
+    """Persistent XLA compile cache — the tunneled remote-compile service
+    has multi-hour flaky stretches (BASELINE.md); cached programs survive
+    them and reruns. Shared by every benchmark in this directory."""
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                         ".jax_cache")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass

@@ -100,7 +100,7 @@ def run(kind, steps=STEPS_WARM + STEPS_TIMED, record_aux=False):
         "params_m": round(n_params / 1e6, 1),
         "median_step_s": round(med, 4),
         "tokens_per_s": round(B * T / med, 1),
-        "loss_first": float(np.round(float(loss), 4)),
+        "loss_final": float(np.round(float(loss), 4)),
         "aux_trajectory": [round(a, 5) for a in aux_traj] or None,
     }
 
@@ -139,15 +139,16 @@ def expert_balance():
     import flax
 
     probe = {"input_ids": rng.integers(0, 32768, (B, T)).astype(np.int32)}
-    params = engine.state["params"]
 
     counts = {}
 
-    def capture(mdl, batch):
-        return mdl.apply({"params": params}, batch, deterministic=True,
-                         capture_intermediates=lambda m, _: isinstance(m, MoE))
+    # params as an ARGUMENT — a closure would bake 370M weights into the
+    # HLO as constants (a program the remote-compile service rejects)
+    def capture(p, batch):
+        return model.apply({"params": p}, batch, deterministic=True,
+                           capture_intermediates=lambda m, _: isinstance(m, MoE))
 
-    out, inter = jax.jit(lambda b: capture(model, b))(probe)
+    out, inter = jax.jit(capture)(engine.state["params"], probe)
     flat = flax.traverse_util.flatten_dict(inter["intermediates"])
     for path, vals in flat.items():
         if path[-1] == "__call__":
@@ -157,21 +158,7 @@ def expert_balance():
     return aux_traj, shares
 
 
-def _enable_cache():
-    """Persistent XLA compile cache — the tunneled remote-compile service
-    has multi-hour flaky stretches (BASELINE.md); cached programs survive
-    them and reruns."""
-    import jax
-
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                         ".jax_cache")
-    try:
-        os.makedirs(cache, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    except Exception:
-        pass
+from _bench_util import enable_persistent_cache as _enable_cache  # noqa: E402
 
 
 def main():

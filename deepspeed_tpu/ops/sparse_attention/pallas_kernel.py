@@ -342,10 +342,13 @@ def _build_sparse_fn(layout_key, block: int, causal: bool, scale: float,
     entry across calls.
     """
     layout = np.frombuffer(layout_key[0], np.int32).reshape(layout_key[1])
-    idx_np, cnt_np = layout_to_schedule(layout)
-    idx_t_np, cnt_t_np = layout_to_schedule(layout.transpose(0, 2, 1))
-    idx, cnt = jnp.asarray(idx_np), jnp.asarray(cnt_np)
-    idx_t, cnt_t = jnp.asarray(idx_t_np), jnp.asarray(cnt_t_np)
+    # schedule arrays stay HOST numpy in this (lru_cached) closure ON
+    # PURPOSE: jnp constants built here would be tracers of whichever
+    # trace first populated the cache entry, and a later trace hitting
+    # the same key would receive leaked tracers (UnexpectedTracerError).
+    # numpy closures materialize fresh per-trace constants on use.
+    idx, cnt = layout_to_schedule(layout)
+    idx_t, cnt_t = layout_to_schedule(layout.transpose(0, 2, 1))
 
     @jax.custom_vjp
     def attn(q, k, v):
