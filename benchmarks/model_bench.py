@@ -95,13 +95,14 @@ def main():
             0, cfg.vocab_size,
             (engine.train_batch_size(), args.seq)).astype(np.int32)}
 
-    # compile + warmup
+    # compile + warmup. float(loss) — NOT block_until_ready — forces
+    # completion: on the tunneled runtime block_until_ready can return
+    # early (attn_bench.timed documents the same), which with a warm
+    # compile cache turns the timing loop into dispatch-only nonsense.
     t0 = time.perf_counter()
-    loss = engine.train_batch(batch=batch())
-    jax.block_until_ready(loss)
+    loss = float(engine.train_batch(batch=batch()))
     compile_s = time.perf_counter() - t0
-    loss = engine.train_batch(batch=batch())
-    jax.block_until_ready(loss)
+    loss = float(engine.train_batch(batch=batch()))
 
     tokens_per_step = engine.train_batch_size() * args.seq
     n_params = engine.num_parameters
@@ -143,7 +144,7 @@ def main():
         t0 = time.perf_counter()
         for _ in range(args.steps):
             loss = engine.train_batch(batch=batch())
-        jax.block_until_ready(loss)
+        loss = float(loss)  # forces completion (see warmup note)
         dt = (time.perf_counter() - t0) / args.steps
         tok_s = tokens_per_step / dt
         row["step_s"] = round(dt, 3)

@@ -50,6 +50,10 @@ class GPT2Config:
     # sequence/context parallelism over the `seq` mesh axis:
     # None | "ring" (ppermute KV rotation) | "ulysses" (all-to-all head swap)
     sequence_parallel: Optional[str] = None
+    # lax.scan unroll factor over the stacked blocks: >1 lets XLA schedule
+    # across layer boundaries (scan steps otherwise materialize the carry
+    # and serialize); costs compile time proportionally
+    scan_unroll: int = 1
     # block-sparse attention: a SparsityConfig (ops/sparse_attention) —
     # every attention layer computes only the layout's blocks via the
     # fused Pallas kernel (gather formulation off-TPU / fine granules).
@@ -267,6 +271,7 @@ class GPT2LMHeadModel(nn.Module):
             length=cfg.n_layer,
             in_axes=nn.broadcast,
             metadata_params={nn.PARTITION_NAME: "layers"},
+            unroll=cfg.scan_unroll,
         )(cfg, name="blocks")
         self.ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                                  name="ln_f")
