@@ -1,0 +1,96 @@
+"""Block sweep for the bf16-MXU flash kernel at the flagship attention
+shape (GPT-2 350M: B10 H16 D64 seq1024) vs XLA's fused attention.
+
+Round-5 follow-up to the r2 crossover table: the kernels previously cast
+all MXU operands to fp32 (fraction of peak on v5e); after the bf16-operand
+rework this sweep decides whether the flash crossover moves below 2048.
+
+Run ON the real chip: python benchmarks/flash1k_sweep.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from attn_bench import timed  # noqa: E402  (in-jit fori_loop timing)
+
+
+def main():
+    import jax.numpy as jnp
+    import numpy as np
+
+    import jax
+    from deepspeed_tpu.ops.attention.flash_attention import flash_attention
+
+    print("backend:", jax.default_backend(), flush=True)
+    H, D = 16, 64
+    rng = np.random.default_rng(0)
+
+    def xla_attn(q, k, v):
+        s = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(D)
+        mask = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhts,bshd->bthd", p, v)
+
+    def loss_of(attn):
+        def f(q, k, v):
+            return attn(q, k, v).astype(jnp.float32).sum()
+
+        grad_f = jax.grad(f, argnums=(0, 1, 2))
+
+        def scalar(q, k, v):
+            gq, gk, gv = grad_f(q, k, v)
+            return (gq.astype(jnp.float32).sum() +
+                    gk.astype(jnp.float32).sum() +
+                    gv.astype(jnp.float32).sum())
+
+        return scalar
+
+    results = []
+    for seq, B in ((1024, 10), (2048, 4)):
+        shape = (B, seq, H, D)
+        q, k, v = (jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+                   for _ in range(3))
+        row = {"seq": seq, "batch": B, "heads": H, "head_dim": D}
+        row["xla_ms"] = timed(loss_of(xla_attn), q, k, v) * 1e3
+        sweep = {}
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512, 1024):
+                if bq > seq or bk > seq:
+                    continue
+                fn = functools.partial(flash_attention, causal=True,
+                                       block_q=bq, block_k=bk)
+                try:
+                    sweep[f"{bq}x{bk}"] = round(
+                        timed(loss_of(fn), q, k, v) * 1e3, 3)
+                except Exception as e:  # noqa: BLE001
+                    sweep[f"{bq}x{bk}"] = str(e)[:80]
+                print(seq, f"{bq}x{bk}", sweep[f"{bq}x{bk}"], flush=True)
+        numeric = {k2: t for k2, t in sweep.items()
+                   if isinstance(t, float)}
+        row["flash_sweep_ms"] = sweep
+        if numeric:
+            best = min(numeric, key=numeric.get)
+            row["best_blocks"] = best
+            row["best_flash_ms"] = numeric[best]
+            row["flash_speedup_vs_xla"] = round(
+                row["xla_ms"] / numeric[best], 3)
+        results.append(row)
+        print(row, flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "flash1k_sweep_results.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote", out, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -67,11 +67,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(jnp.asarray(live))
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # MXU operands stay in the input dtype (bf16 in training): v5e runs
+        # bf16xbf16->fp32 at full rate but fp32 matmuls at a fraction of it.
+        # Accumulation/statistics are fp32 (preferred_element_type); p is
+        # cast back to the input dtype for the PV dot (FA2 discipline).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -84,7 +88,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(j == num_kv - 1)
@@ -156,14 +161,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(jnp.asarray(live))
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 MXU operands, fp32 stats/accumulator (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0][:, None]  # stats replicated over sublane dim
         delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -171,7 +177,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         dq_acc_ref[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                              preferred_element_type=jnp.float32)
 
@@ -200,30 +206,33 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
     @pl.when(jnp.asarray(live))
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 MXU operands, fp32 stats/accumulators (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk)
-        dv_acc_ref[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        p_lo = p.astype(do.dtype)
+        dv_acc_ref[:] += jax.lax.dot_general(p_lo, do, (((0,), (0,)), ((), ())),
                                              preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_acc_ref[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                              preferred_element_type=jnp.float32)
 
     @pl.when(i == num_q - 1)
     def _finish():
-        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        # q is unscaled in the s recompute, so dk picks up the scale here
+        dk_ref[0] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
@@ -327,16 +336,15 @@ _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
 def auto_block_sizes(seq: int) -> "tuple[int, int]":
-    """(block_q, block_k) tuned on v5e (BASELINE.md crossover table):
-    bigger blocks amortize grid overhead; the best mix grows with seq.
-    Each block is shrunk (halved) until it divides ``seq`` — the kernel
-    requires exact tiling, and an odd seq must not crash the auto path."""
+    """(block_q, block_k) tuned on v5e with bf16 MXU operands (round-5
+    sweep, benchmarks/flash1k_sweep_results.json + the r2 crossover table):
+    512x1024 wins at 1024-4096; the biggest tiles win at >=8192. Each block
+    is shrunk (halved) until it divides ``seq`` — the kernel requires exact
+    tiling, and an odd seq must not crash the auto path."""
     if seq >= 8192:
         bq, bk = 1024, 1024
-    elif seq >= 4096:
+    elif seq >= 1024:
         bq, bk = 512, 1024
-    elif seq >= 2048:
-        bq, bk = 512, 512
     else:
         bq, bk = DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
     while bq > 1 and seq % bq != 0:
@@ -347,14 +355,15 @@ def auto_block_sizes(seq: int) -> "tuple[int, int]":
 
 
 def use_flash_by_default(seq: int) -> bool:
-    """Shape-based auto-selection: the Pallas kernel beats XLA's fused
-    attention from seq 2048 up on TPU (1.0x @2k, 2.0x @4k, 2.3x @8k —
-    BASELINE.md); below that XLA wins. Off-TPU (interpret mode) it is only
-    for tests. Shapes whose auto blocks would degenerate (seq with a tiny
-    power-of-two factor) stay on XLA."""
+    """Shape-based auto-selection: with bf16 MXU operands (round 5) the
+    Pallas kernel beats XLA's fused attention from seq 1024 up on TPU
+    (1.55x @1k, 1.33x @2k — benchmarks/flash1k_sweep_results.json; 2x+ at
+    4k-8k, BASELINE.md crossover table); below that XLA wins. Off-TPU
+    (interpret mode) it is only for tests. Shapes whose auto blocks would
+    degenerate (seq with a tiny power-of-two factor) stay on XLA."""
     import jax
 
-    return jax.default_backend() == "tpu" and seq >= 2048 \
+    return jax.default_backend() == "tpu" and seq >= 1024 \
         and min(auto_block_sizes(seq)) >= 128
 
 

@@ -82,11 +82,13 @@ def _sparse_fwd_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(m < cnt_ref[h, i])
     def _compute():
         kb = idx_ref[h, i, m]
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # MXU operands stay in the input dtype (bf16 at full rate on v5e);
+        # accumulation/statistics fp32; p cast back for the PV dot
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             rows = i * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
@@ -101,7 +103,8 @@ def _sparse_fwd_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:] = jnp.broadcast_to(
             alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(m == num_m - 1)
@@ -173,14 +176,15 @@ def _sparse_dq_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(m < cnt_ref[h, i])
     def _compute():
         kb = idx_ref[h, i, m]
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 MXU operands, fp32 stats/accumulator (see fwd kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             rows = i * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
@@ -190,7 +194,7 @@ def _sparse_dq_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         dq_acc_ref[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                              preferred_element_type=jnp.float32)
 
@@ -216,14 +220,15 @@ def _sparse_dkv_kernel(idx_t_ref, cnt_t_ref, q_ref, k_ref, v_ref, do_ref,
     @pl.when(m < cnt_t_ref[h, j])
     def _compute():
         qb = idx_t_ref[h, j, m]
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 MXU operands, fp32 stats/accumulators (see fwd kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             rows = qb * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
@@ -231,17 +236,19 @@ def _sparse_dkv_kernel(idx_t_ref, cnt_t_ref, q_ref, k_ref, v_ref, do_ref,
                 jnp.int32, (block, block), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv_acc_ref[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        p_lo = p.astype(do.dtype)
+        dv_acc_ref[:] += jax.lax.dot_general(p_lo, do, (((0,), (0,)), ((), ())),
                                              preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_acc_ref[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                              preferred_element_type=jnp.float32)
 
     @pl.when(m == num_m - 1)
     def _finish():
-        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        # q is unscaled in the s recompute, so dk picks up the scale here
+        dk_ref[0] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
