@@ -32,6 +32,10 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# checkpoint_name tags on attention-kernel outputs (see _flash_attention_fwd);
+# remat policies compose save_only_these_names(*ATTN_SAVE_NAMES) so the
+# backward pass reuses the forward kernel's (out, lse) instead of re-running it
+ATTN_SAVE_NAMES = ("flash_out", "flash_lse")
 # TPU vector layout: fp32 tiles are (8 sublanes, 128 lanes). Row statistics
 # (lse, delta) are carried replicated across a size-8 sublane dim so their
 # blocks satisfy the (8, 128) tiling rule; stats scratch is lane-width.
@@ -46,6 +50,39 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 # forward: grid (bh, q_blocks, kv_blocks), scratch carries (acc, m, l)
 # ---------------------------------------------------------------------------
+def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                       scale: float, causal: bool):
+    """One-KV-block specialization (block_k == seq_k): plain block softmax.
+
+    The tuned table picks block_k = seq for seq <= 1024 (and 512x1024 tiles
+    generally), where the KV grid axis has a single step — the online-softmax
+    running stats (acc rescale, m/l scratch round-trips, alpha exps) are pure
+    overhead there. This kernel computes max/exp/sum once and writes out
+    directly from registers/VMEM."""
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=1, keepdims=True), 1e-30)
+    acc = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_row = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = jnp.broadcast_to(lse_row[None, :], lse_ref.shape[1:])
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 scale: float, causal: bool):
     block_q = q_ref.shape[1]
@@ -107,6 +144,33 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: in
     block_k = min(block_k, seq_k)
     assert seq_q % block_q == 0 and seq_k % block_k == 0, \
         f"seq ({seq_q},{seq_k}) must be divisible by blocks ({block_q},{block_k})"
+
+    if seq_k == block_k:
+        # single KV step: no online stats needed (see _fwd_single_kernel)
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_single_kernel, scale=scale, causal=causal),
+            grid=(bh, seq_q // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, SUBLANES, block_q), lambda b, i: (b, 0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, SUBLANES, seq_q), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(q, k, v)
+        return out, lse
 
     grid = (bh, seq_q // block_q, seq_k // block_k)
     out, lse = pl.pallas_call(
@@ -322,6 +386,17 @@ def _flash_attention(q, k, v, causal, scale, block_q, block_k):
 def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k):
     out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
                           block_k=block_k)
+    # Name the kernel outputs so activation-checkpoint policies can save
+    # them: under the "dots" policy alone a rematerialized block re-runs the
+    # whole forward kernel in the backward pass (pallas_call outputs are not
+    # dot_general outputs). remat_policy="dots" composes
+    # save_only_these_names(*ATTN_SAVE_NAMES) on top, which keeps (out, lse)
+    # and skips the recompute; q/k/v re-derive cheaply from the saved qkv
+    # projection dot.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

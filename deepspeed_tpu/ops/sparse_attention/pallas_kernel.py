@@ -364,8 +364,15 @@ def _build_sparse_fn(layout_key, block: int, causal: bool, scale: float,
         return out
 
     def attn_fwd(q, k, v):
+        from jax.ad_checkpoint import checkpoint_name
+
         out, lse = _sparse_fwd(q, k, v, idx, cnt, scale=scale, causal=causal,
                                block=block, num_heads=num_heads)
+        # same checkpoint_name discipline as flash_attention: lets the
+        # "dots" remat policy save (out, lse) and skip re-running the
+        # forward kernel in the backward pass
+        out = checkpoint_name(out, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
         return out, (q, k, v, out, lse)
 
     def attn_bwd(res, do):

@@ -176,14 +176,16 @@ class OffloadedOptimizer:
 
         return aligned_array(n * 4).view(np.float32)
 
-    def _submit_swap_in_all(self) -> Dict[str, list]:
+    def _submit_swap_in_all(self, keys=None) -> Dict[str, list]:
         """Allocate every swapped-out leaf's buffers and SUBMIT their reads
         without draining. Returns {leaf: [tickets]} for per-leaf
         ``wait_ticket`` — the pipelined step overlaps leaf i's Adam compute
-        with leaves i+1..'s reads."""
+        with leaves i+1..'s reads. ``keys`` restricts to a subset (the
+        param-offload finalize swaps resident leaves only; stacked leaves
+        go through ``step_rows``)."""
         tickets: Dict[str, list] = {}
         for p, shape in self._shapes.items():
-            if not self._float[p]:
+            if not self._float[p] or (keys is not None and p not in keys):
                 continue
             if self.m[p] is not None:
                 continue  # in-memory copy live (see _swap_in_all)
@@ -255,9 +257,66 @@ class OffloadedOptimizer:
             self._aio.wait()
         return True
 
+    # --- per-row (layer-streamed) step ----------------------------------
+    def step_rows(self, key: str, row: int, grad_row: np.ndarray, lr: float,
+                  step_num: int, compute_dtype, grad_scale: float = 1.0
+                  ) -> np.ndarray:
+        """Adam-update ONE leading-axis row of a stacked leaf and return
+        the new compute-dtype row (param_offload's layer-streamed finalize:
+        host DRAM never holds a full new param tree — O(row) transient).
+
+        In the NVMe tier the row's master/moment slices move with
+        byte-offset I/O against the whole-leaf files (layout: moments
+        raveled 1-D, master raveled row-major, so row ``i`` of an
+        ``(L, *s)`` leaf is the contiguous span ``[i*n, (i+1)*n)``)."""
+        import ml_dtypes
+
+        shape = self._shapes[key]
+        assert shape and self._float[key], key
+        n = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        off = row * n * 4
+        g = np.ascontiguousarray(np.asarray(grad_row, np.float32)).ravel()
+        if grad_scale != 1.0:
+            g = g * np.float32(grad_scale)
+        swapped = self.nvme and self.m[key] is None
+        if swapped:
+            m = self._alloc(n)
+            v = self._alloc(n)
+            t = [self._aio.async_pread(m, self._leaf_file(key, "m"), off),
+                 self._aio.async_pread(v, self._leaf_file(key, "v"), off)]
+            if self.swap_master:
+                master = self._alloc(n)
+                t.append(self._aio.async_pread(
+                    master, self._leaf_file(key, "master"), off))
+            else:
+                master = self.master[key].reshape(-1)[row * n:(row + 1) * n]
+            for ticket in t:
+                self._aio.wait_ticket(ticket)
+        else:
+            m = self.m[key][row * n:(row + 1) * n]
+            v = self.v[key][row * n:(row + 1) * n]
+            master = self.master[key].reshape(-1)[row * n:(row + 1) * n]
+        self.opt.step(master, g, m, v, step_num, lr=lr)
+        if swapped:
+            self._aio.async_pwrite(m, self._leaf_file(key, "m"), off)
+            self._aio.async_pwrite(v, self._leaf_file(key, "v"), off)
+            if self.swap_master:
+                self._aio.async_pwrite(master,
+                                       self._leaf_file(key, "master"), off)
+            self._aio.wait()
+        if compute_dtype is not None and \
+                np.dtype(compute_dtype) == np.dtype(ml_dtypes.bfloat16):
+            new_row = self.opt.to_bf16(master)
+        elif compute_dtype is None:
+            new_row = master.copy()
+        else:
+            new_row = master.astype(compute_dtype)
+        return new_row.reshape(shape[1:])
+
     # --- step -----------------------------------------------------------
     def step(self, grads_host, lr: float, step_num: int, compute_dtype,
-             grad_scale: float = 1.0, release_grads: bool = False):
+             grad_scale: float = 1.0, release_grads: bool = False,
+             keys=None):
         """Apply one host Adam step. ``grads_host``: pytree of fp32 numpy
         (already unscaled/clipped, or scaled here via ``grad_scale`` —
         applied in the per-leaf contiguous copy, so deferred clip/averaging
@@ -265,8 +324,13 @@ class OffloadedOptimizer:
         reference the moment its update finishes — with the caller's own
         references dropped, peak host RAM falls as the step progresses
         (the streamed param-offload path hands over ~param-sized fp32
-        buffers). Returns the new compute-dtype param pytree (host arrays,
-        ready for device_put). ``step_num`` 1-indexed.
+        buffers). NOTE the in-place contract: with ``release_grads=True``
+        and a dict ``grads_host``, this method SETS the caller's dict
+        values to None as updates complete — pass an owned dict, not one
+        reused after step(). Returns the new compute-dtype param pytree
+        (host arrays, ready for device_put); with ``keys`` set, only that
+        subset is updated and a flat ``{path: new_leaf}`` dict is returned
+        instead. ``step_num`` 1-indexed.
 
         NVMe tier pipelining (≅ PipelinedOptimizerSwapper): ALL leaves'
         swap-in reads are submitted up front and the compute loop waits
@@ -283,7 +347,7 @@ class OffloadedOptimizer:
         t0 = time.perf_counter()
         tickets: Dict[str, list] = {}
         if self.nvme:
-            tickets = self._submit_swap_in_all()
+            tickets = self._submit_swap_in_all(keys=keys)
         t_in = time.perf_counter()
         grads = _flatten_with_paths(grads_host)
         out: Dict[str, np.ndarray] = {}
@@ -291,6 +355,8 @@ class OffloadedOptimizer:
             np.dtype(compute_dtype) == np.dtype(ml_dtypes.bfloat16)
         try:
             for p, master in self.master.items():
+                if keys is not None and p not in keys:
+                    continue
                 if not self._float[p]:
                     out[p] = master
                     continue
@@ -365,6 +431,8 @@ class OffloadedOptimizer:
         self.last_timings = {"swap_in_s": t_in - t0,
                              "compute_s": t_compute - t_in,
                              "drain_s": t_drain - t_compute}
+        if keys is not None:
+            return out
         return _unflatten_like(self._template, out)
 
     def sync_master_from(self, params_host) -> None:

@@ -57,7 +57,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ...parallel import mesh as mesh_mod
 from ...utils.logging import log_dist
 from ...utils.streaming import LayerWireFormat
-from .offload import OffloadedOptimizer, _flatten_with_paths
+from .offload import OffloadedOptimizer, _flatten_with_paths, _unflatten_like
 from .offload_config import OffloadDeviceEnum
 
 
@@ -66,20 +66,22 @@ def _path_str(path) -> str:
                     for k in path)
 
 
+def _writable_tree(tree):
+    """Leaf-wise: keep writable numpy arrays, copy anything else (numpy
+    views of jax arrays are read-only; write_layer mutates rows in place)."""
+    return jax.tree_util.tree_map(
+        lambda a: a if getattr(a, "flags", None) is not None
+        and a.flags.writeable else np.array(a), tree)
+
+
 def check_supported(engine) -> None:
     """Fail at initialize() with actionable messages (mirrors the onebit
-    wire's up-front validation)."""
-    from ...models.transformer_lm import TransformerConfig
+    wire's up-front validation). Round 5: model support went through the
+    adapter registry (stream_adapters.make_adapter — TransformerLM +
+    GPT2LMHeadModel) and dropout>0 is allowed (per-layer rng threading)."""
+    from .stream_adapters import make_adapter
 
-    cfg = getattr(engine.module, "config", None)
-    if not isinstance(cfg, TransformerConfig):
-        raise ValueError(
-            "offload_param streaming requires a TransformerLM module "
-            "(scan-stacked blocks to stream); got "
-            f"{type(engine.module).__name__}")
-    if cfg.dropout > 0:
-        raise ValueError("offload_param training path requires dropout=0 "
-                         "(streamed per-layer vjp carries no rng plumbing)")
+    make_adapter(engine.module, engine.compute_dtype)  # raises if unsupported
     opt_type = (engine._config.optimizer.type
                 if engine._config.optimizer else "adam").lower()
     if opt_type not in ("adam", "adamw", "cpuadam"):
@@ -151,16 +153,20 @@ class LayerParamStore:
                 o_direct=use_od,
                 single_submit=ac.single_submit if ac else False,
                 overlap_events=ac.overlap_events if ac else True)
-            # O_DIRECT-compatible staging buffers + one pack buffer
+            # O_DIRECT-compatible staging buffers + a double-buffered pack
+            # pair: packing layer i+1 overlaps the write of layer i
             self._staging = [aligned_array(self.layer_nbytes)
                              for _ in range(n_slots)]
-            self._packbuf = aligned_array(self.layer_nbytes)
+            self._packbufs = [aligned_array(self.layer_nbytes)
+                              for _ in range(2)]
+            self._pack_tickets: List[Optional[int]] = [None, None]
+            self._pack_turn = 0
             self.stacked = None
             self._write_all_layers(stacked_host)
         else:
             self._staging = [np.empty(self.layer_nbytes, np.uint8)
                              for _ in range(n_slots)]
-            self.stacked = stacked_host
+            self.stacked = _writable_tree(stacked_host)
         # streaming bookkeeping (begin_pass/next_layer)
         self._order: List[int] = []
         self._pos = 0
@@ -176,15 +182,47 @@ class LayerParamStore:
     def _pack_into(self, layer_tree, buf: np.ndarray) -> None:
         self.wire.pack_into(layer_tree, buf)
 
+    def write_layer(self, i: int, layer_tree) -> None:
+        """Install ONE layer's new params (host arrays, wire dtypes).
+
+        cpu tier: in-place row copy into the resident stacked tree (no new
+        allocation). nvme tier: pack into the free half of the
+        double-buffered pack pair and submit the file write — packing
+        layer i+1 overlaps the write of layer i; call ``flush_writes``
+        after the last layer."""
+        if not self.nvme:
+            for dst, src in zip(jax.tree_util.tree_leaves(self.stacked),
+                                jax.tree_util.tree_leaves(layer_tree)):
+                np.copyto(dst[i], np.asarray(src).astype(dst.dtype,
+                                                         copy=False))
+            return
+        turn = self._pack_turn
+        if self._pack_tickets[turn] is not None:
+            self._aio.wait_ticket(self._pack_tickets[turn])
+            self._pack_tickets[turn] = None
+        buf = self._packbufs[turn]
+        self._pack_into(layer_tree, buf)
+        self._pack_tickets[turn] = self._aio.async_pwrite(
+            buf, self._layer_file(i))
+        self._pack_turn = 1 - turn
+
+    def flush_writes(self) -> None:
+        if not self.nvme:
+            return
+        for t, ticket in enumerate(self._pack_tickets):
+            if ticket is not None:
+                self._aio.wait_ticket(ticket)
+                self._pack_tickets[t] = None
+
     def _write_all_layers(self, stacked) -> None:
-        """(Re)write every per-layer NVMe file from a stacked host tree."""
+        """(Re)write every per-layer NVMe file from a stacked host tree
+        (init / checkpoint-restore path; the training step streams
+        per-layer via ``write_layer`` instead)."""
         for i in range(self.n_layer):
             layer = jax.tree_util.tree_map(lambda a: np.asarray(a[i]),
                                            stacked)
-            self._pack_into(layer, self._packbuf)
-            self._aio.async_pwrite(self._packbuf, self._layer_file(i))
-            # one pack buffer: drain before reusing it for the next layer
-            self._aio.wait()
+            self.write_layer(i, layer)
+        self.flush_writes()
 
     def unpack(self, flat):
         """Traced: packed buffer -> layer param tree. Training wires are
@@ -258,11 +296,12 @@ class LayerParamStore:
         return i, dev
 
     def update_from_stacked(self, new_stacked) -> None:
-        """Install the post-optimizer-step params (host bf16 stacked tree)."""
+        """Install a full stacked host tree (checkpoint-restore path; the
+        training step streams per-layer via ``write_layer`` instead)."""
         if self.nvme:
             self._write_all_layers(new_stacked)
         else:
-            self.stacked = new_stacked
+            self.stacked = _writable_tree(new_stacked)
 
     def materialize_stacked(self):
         """Full stacked host tree (reads every NVMe layer file) — the
@@ -284,6 +323,111 @@ class LayerParamStore:
         return jax.tree_util.tree_unflatten(self.treedef, out_leaves)
 
 
+class GradRowStore:
+    """Per-layer gradient accumulation for the streamed backward.
+
+    dram mode: fp32 row arrays per (leaf, layer), freed per layer by the
+    finalize. nvme mode (the full ZeRO-Infinity grad tier,
+    ``swap_tensor``'s gradient swap analog): each layer's packed fp32 grad
+    rows live in ONE file; accumulation is read-modify-write per micro
+    batch and the per-layer sum-of-squares is captured on the LAST micro,
+    so the global-norm clip never needs the whole grad tree in DRAM —
+    host memory stays O(layer) for the entire step."""
+
+    def __init__(self, n_layer: int, leaf_shapes, nvme_dir: Optional[str],
+                 aio=None):
+        self.n_layer = n_layer
+        self.leaf_shapes = list(leaf_shapes)
+        self._sizes = [int(np.prod(s)) if s else 1 for s in self.leaf_shapes]
+        self._offsets = np.cumsum([0] + self._sizes)
+        self.total = int(self._offsets[-1])
+        self.nvme = nvme_dir is not None
+        self.sq: Dict[int, float] = {}
+        if self.nvme:
+            import os
+
+            from ...ops.aio import aligned_array
+
+            self.dir = os.path.join(nvme_dir, "grads")
+            os.makedirs(self.dir, exist_ok=True)
+            self._aio = aio
+            self._buf = aligned_array(self.total * 4).view(np.float32)
+            self._have: set = set()
+        else:
+            self.rows: Dict[int, Optional[np.ndarray]] = {}
+
+    def _file(self, li: int) -> str:
+        import os
+
+        return os.path.join(self.dir, f"grad_{li:05d}.bin")
+
+    def _pack(self, leaves, out: np.ndarray) -> None:
+        for off, size, leaf in zip(self._offsets, self._sizes, leaves):
+            out[off:off + size] = np.asarray(leaf, np.float32).ravel()
+
+    def accumulate(self, li: int, leaves, is_last: bool) -> None:
+        """Add one micro batch's fp32 grad rows for layer ``li``; on the
+        last micro also record the layer's sum of squares."""
+        if not self.nvme:
+            flat = self.rows.get(li)
+            if flat is None:
+                flat = np.empty(self.total, np.float32)
+                self._pack(leaves, flat)
+                self.rows[li] = flat
+            else:
+                for off, size, leaf in zip(self._offsets, self._sizes,
+                                           leaves):
+                    flat[off:off + size] += np.asarray(
+                        leaf, np.float32).ravel()
+            if is_last:
+                self.sq[li] = float(np.dot(flat, flat))
+            return
+        # per-ticket waits only: the AioHandle is SHARED with
+        # LayerParamStore — a handle-global wait() here would drain the
+        # store's in-flight layer prefetches / pack writes and serialize
+        # the streaming pipeline
+        if li in self._have:
+            t = self._aio.async_pread(self._buf, self._file(li))
+            self._aio.wait_ticket(t)
+            for off, size, leaf in zip(self._offsets, self._sizes, leaves):
+                self._buf[off:off + size] += np.asarray(
+                    leaf, np.float32).ravel()
+        else:
+            self._pack(leaves, self._buf)
+            self._have.add(li)
+        if is_last:
+            self.sq[li] = float(np.dot(self._buf, self._buf))
+        t = self._aio.async_pwrite(self._buf, self._file(li))
+        self._aio.wait_ticket(t)
+
+    def total_sq(self) -> float:
+        return float(sum(self.sq.values()))
+
+    def read_rows(self, li: int):
+        """The layer's accumulated fp32 rows (leaf-shaped views)."""
+        if not self.nvme:
+            flat = self.rows[li]
+        else:
+            t = self._aio.async_pread(self._buf, self._file(li))
+            self._aio.wait_ticket(t)  # shared handle: no global wait
+            flat = self._buf
+        return [flat[off:off + size].reshape(shape)
+                for off, size, shape in zip(self._offsets, self._sizes,
+                                            self.leaf_shapes)]
+
+    def free(self, li: int) -> None:
+        if not self.nvme:
+            self.rows[li] = None
+        # nvme: the file is simply overwritten next step
+
+    def reset(self) -> None:
+        self.sq = {}
+        if self.nvme:
+            self._have = set()
+        else:
+            self.rows = {}
+
+
 class ParamOffloadRunner:
     """The engine's ``offload_param`` training path: streamed forward /
     backward over :class:`LayerParamStore` + host :class:`OffloadedOptimizer`
@@ -293,7 +437,7 @@ class ParamOffloadRunner:
                      "lm_head")
 
     def __init__(self, engine, params_host):
-        from ...models.transformer_lm import TransformerBlock, _norm
+        from .stream_adapters import make_adapter
 
         check_supported(engine)
         self.engine = engine
@@ -301,9 +445,12 @@ class ParamOffloadRunner:
         self.cfg = cfg
         self.mesh = engine.mesh
         self.compute_dtype = engine.compute_dtype
+        self.adapter = make_adapter(engine.module, engine.compute_dtype)
         self.clip = engine.gradient_clipping()
         self.gas = engine.gradient_accumulation_steps()
         self.op_cfg = engine.zero_config.offload_param
+        self._base_rng = jax.random.PRNGKey(
+            getattr(engine._config, "seed", 1234) or 1234)
 
         params_host = jax.tree_util.tree_map(lambda a: np.asarray(a),
                                              params_host)
@@ -325,9 +472,7 @@ class ParamOffloadRunner:
                                       aio_config=engine._config.aio)
 
         # split the tree: resident (device) vs streamed (store)
-        self._resident_host = {k: v for k, v in params_host.items()
-                               if k != "blocks"}
-        stacked = params_host["blocks"]["block"]
+        self._resident_host, stacked = self.adapter.split(params_host)
         self.store = LayerParamStore(
             stacked, cfg.n_layer, self.compute_dtype, self.op_cfg.device,
             nvme_dir=self.op_cfg.nvme_path, aio_config=engine._config.aio,
@@ -349,21 +494,28 @@ class ParamOffloadRunner:
 
         self.resident = to_dev(self._resident_host)
 
-        block = TransformerBlock(cfg)
+        adapter = self.adapter
         unpack = self.store.unpack
 
         # ---- jitted pieces (each reused for every layer/micro) --------
-        def block_fwd(packed, x):
-            return block.apply({"params": unpack(packed)}, x, False, True)
+        def block_fwd(packed, x, rng):
+            return adapter.block_apply(unpack(packed), x, rng)
 
         self._jit_block_fwd = jax.jit(
             block_fwd, out_shardings=self._data_sh)
 
-        def block_bwd(packed, x, dy):
+        def block_fwd_eval(packed, x, rng):
+            return adapter.block_apply(unpack(packed), x, rng,
+                                       deterministic=True)
+
+        self._jit_block_fwd_eval = jax.jit(
+            block_fwd_eval, out_shardings=self._data_sh)
+
+        def block_bwd(packed, x, dy, rng):
             layer = unpack(packed)
 
             def f(lp, xi):
-                return block.apply({"params": lp}, xi, False, True)
+                return adapter.block_apply(lp, xi, rng)
 
             _, vjp = jax.vjp(f, layer, x)
             dlayer, dx = vjp(dy)
@@ -377,45 +529,12 @@ class ParamOffloadRunner:
         self._jit_block_bwd = jax.jit(
             block_bwd, out_shardings=(self._data_sh, grad_rep))
 
-        def embed_fwd(resident, ids):
-            B, T = ids.shape
-            x = jnp.take(resident["embed_tokens"]["embedding"], ids, axis=0)
-            if cfg.pos_emb == "learned":
-                pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
-                x = x + jnp.take(resident["embed_pos"]["embedding"], pos,
-                                 axis=0)
-            if cfg.embed_layernorm:
-                x = _norm(cfg, "embed_ln").apply(
-                    {"params": resident["embed_ln"]}, x)
-            return x.astype(self.compute_dtype)
+        def embed_fwd(resident, batch):
+            return adapter.embed_apply(resident, batch)
 
         self._jit_embed = jax.jit(embed_fwd, out_shardings=self._data_sh)
 
-        def head_loss(resident, xL, batch):
-            # EXACTLY TransformerLM.__call__'s tail (shift + masked xent).
-            # Tied head: Embed.attend promotes both operands to cfg.dtype
-            # (the module casts x to f32 and flax promotes back down), so
-            # the matmul runs in compute dtype — matching it keeps bf16
-            # trajectories identical to the resident engine.
-            x = _norm(cfg, "ln_f").apply({"params": resident["ln_f"]}, xL)
-            if cfg.tie_word_embeddings:
-                emb = resident["embed_tokens"]["embedding"]
-                logits = x.astype(cfg.dtype) @ \
-                    emb.T.astype(cfg.dtype)
-            else:
-                logits = x.astype(jnp.float32) @ \
-                    resident["lm_head"]["kernel"].astype(jnp.float32)
-            input_ids = batch["input_ids"]
-            labels = batch.get("labels", input_ids) \
-                if hasattr(batch, "get") else input_ids
-            logits = logits[:, :-1]
-            targets = labels[:, 1:]
-            mask = (targets >= 0).astype(jnp.float32)
-            targets = jnp.maximum(targets, 0)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(
-                logp, targets[..., None], axis=-1)[..., 0]
-            return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        head_loss = adapter.head_loss
 
         def head_bwd(resident, xL, batch):
             (loss, (dres, dx)) = jax.value_and_grad(
@@ -425,9 +544,13 @@ class ParamOffloadRunner:
         res_rep = jax.tree_util.tree_map(lambda _: rep, self.resident)
         self._jit_head_bwd = jax.jit(
             head_bwd, out_shardings=(rep, res_rep, self._data_sh))
+        # loss-only head for evaluation: no value_and_grad over the
+        # resident tree (ADVICE r4: eval_loss must not pay the head
+        # backward + gradient buffers)
+        self._jit_head_loss = jax.jit(head_loss, out_shardings=rep)
 
-        def embed_bwd(resident, ids, dx0, dres_head):
-            _, vjp = jax.vjp(lambda r: embed_fwd(r, ids), resident)
+        def embed_bwd(resident, batch, dx0, dres_head):
+            _, vjp = jax.vjp(lambda r: embed_fwd(r, batch), resident)
             (dres,) = vjp(dx0.astype(self.compute_dtype))
             return jax.tree_util.tree_map(
                 lambda a, b: a.astype(jnp.float32) + b.astype(jnp.float32),
@@ -439,9 +562,13 @@ class ParamOffloadRunner:
         self._acc_add = jax.jit(lambda a, b: jax.tree_util.tree_map(
             lambda x, y: x + y, a, b))
 
-        # host fp32 accumulation buffers for the stacked grads, lazily
-        # allocated (O(params) fp32 — freed progressively by the optimizer)
-        self._stacked_grad_acc: Optional[Dict[str, np.ndarray]] = None
+        # per-layer grad accumulation: DRAM rows (cpu tier) or per-layer
+        # NVMe files (nvme tier — the ZeRO-Infinity gradient-swap analog,
+        # O(layer) host DRAM for the whole step)
+        self.grads = GradRowStore(
+            self.store.n_layer, self.store.leaf_shapes,
+            self.store.dir if self.store.nvme else None,
+            aio=self.store._aio)
         self.last_timings: Dict[str, float] = {}
         nbytes = self.store.layer_nbytes
         log_dist(
@@ -459,57 +586,60 @@ class ParamOffloadRunner:
                 self.store.treedef, list(range(len(self.store.leaf_shapes)))))
         return ["blocks/block/" + _path_str(p) for p, _ in leaves_wp]
 
-    def _ensure_grad_acc(self):
-        if self._stacked_grad_acc is not None:
-            return
-        self._stacked_grad_acc = {}
-        for path, shape, in zip(self._stacked_paths(), self.store.leaf_shapes):
-            self._stacked_grad_acc[path] = np.zeros(
-                (self.store.n_layer,) + shape, np.float32)
 
     # -- the step ------------------------------------------------------
     def train_batch(self, micro_batches) -> Dict[str, Any]:
         """One global step over ``gas`` micro batches (host numpy trees).
         Returns the engine-shaped metrics dict."""
         t0 = time.perf_counter()
-        self._ensure_grad_acc()
+        self.grads.reset()
         L = self.store.n_layer
         stacked_paths = self._stacked_paths()
         res_grad_acc = None
         loss_sum = 0.0
         t_fwd = t_bwd = 0.0
+        eng = self.engine
+        # per-(micro, layer) dropout keys, one device op per step; numpy
+        # rows feed the jitted block fns (same key for fwd and bwd vjp so
+        # the recompute sees identical masks)
+        step_rng = jax.random.fold_in(self._base_rng, eng.global_steps)
+        np_keys = np.asarray(jax.random.split(
+            step_rng, max(1, len(micro_batches)) * L)).reshape(
+                max(1, len(micro_batches)), L, -1)
 
-        for mb in micro_batches:
+        for mi, mb in enumerate(micro_batches):
             mb = jax.tree_util.tree_map(
                 lambda a: jax.device_put(np.asarray(a), self._data_sh), mb)
             tf0 = time.perf_counter()
-            x = self._jit_embed(self.resident, mb["input_ids"])
+            x = self._jit_embed(self.resident, mb)
             acts = [x]
             self.store.begin_pass(list(range(L)))
-            for _ in range(L):
+            for li in range(L):
                 _, packed = self.store.next_layer()
-                x = self._jit_block_fwd(packed, x)
+                x = self._jit_block_fwd(packed, x, np_keys[mi, li])
                 acts.append(x)
             loss, dres_head, dy = self._jit_head_bwd(
                 self.resident, acts[-1], mb)
             t_fwd += time.perf_counter() - tf0
 
             tb0 = time.perf_counter()
+            is_last = mi == len(micro_batches) - 1
             pending = deque()  # (layer, dlayer) with D2H in flight
             self.store.begin_pass(list(range(L - 1, -1, -1)))
             for li in range(L - 1, -1, -1):
                 _, packed = self.store.next_layer()
-                dy, dlayer = self._jit_block_bwd(packed, acts[li], dy)
+                dy, dlayer = self._jit_block_bwd(packed, acts[li], dy,
+                                                 np_keys[mi, li])
                 acts[li + 1] = None  # free the boundary activation
                 for g in jax.tree_util.tree_leaves(dlayer):
                     g.copy_to_host_async()
                 pending.append((li, dlayer))
                 if len(pending) > 1:
-                    self._drain_grad(pending.popleft(), stacked_paths)
+                    self._drain_grad(pending.popleft(), is_last)
             while pending:
-                self._drain_grad(pending.popleft(), stacked_paths)
+                self._drain_grad(pending.popleft(), is_last)
             dres = self._jit_embed_bwd(
-                self.resident, mb["input_ids"], dy, dres_head)
+                self.resident, mb, dy, dres_head)
             res_grad_acc = dres if res_grad_acc is None else \
                 self._acc_add(res_grad_acc, dres)
             loss_sum += float(loss)
@@ -517,15 +647,18 @@ class ParamOffloadRunner:
             t_bwd += time.perf_counter() - tb0
 
         # ---- finalize: norm, clip, host Adam, store update ------------
+        # Layer-streamed (round 5, VERDICT r4 next-#4): resident leaves go
+        # through the pipelined whole-leaf step; the stacked trunk updates
+        # one LAYER at a time (per-row Adam via step_rows, write_layer
+        # writeback, grad rows freed as they land) — the full new param
+        # tree never materializes in host DRAM.
         t2 = time.perf_counter()
         res_host = jax.device_get(res_grad_acc)
         res_flat = {k: np.asarray(v, np.float32) for k, v in
                     _flatten_with_paths(res_host).items()}
-        grads = dict(self._stacked_grad_acc)
-        grads.update(res_flat)
         inv_gas = 1.0 / float(self.gas)
-        sq = 0.0
-        for a in grads.values():
+        sq = self.grads.total_sq()
+        for a in res_flat.values():
             flat = a.reshape(-1)
             sq += float(np.dot(flat, flat))
         grad_norm = float(np.sqrt(sq)) * inv_gas
@@ -533,29 +666,38 @@ class ParamOffloadRunner:
         if self.clip > 0 and grad_norm > self.clip:
             scale *= self.clip / (grad_norm + 1e-6)
 
-        eng = self.engine
         lr = float(eng._lr_fn(jnp.asarray(eng.global_steps)))
         step_num = eng.global_steps + 1
-        # hand the buffers to the optimizer and drop ours: release_grads
-        # frees each leaf as its update completes
-        self._stacked_grad_acc = None
-        new_params = self.opt.step(
-            grads, lr, step_num, np.dtype(self.compute_dtype),
-            grad_scale=scale, release_grads=True)
-        t3 = time.perf_counter()
-
-        self._resident_host = {k: v for k, v in new_params.items()
-                               if k != "blocks"}
+        new_res_flat = self.opt.step(
+            res_flat, lr, step_num, np.dtype(self.compute_dtype),
+            grad_scale=scale, release_grads=True,
+            keys=set(res_flat.keys()))
+        self._resident_host = _unflatten_like(
+            self._resident_host, new_res_flat)
         self.resident = jax.tree_util.tree_map(
             lambda a: jax.device_put(np.asarray(a), self._rep),
             self._resident_host)
-        self.store.update_from_stacked(new_params["blocks"]["block"])
+        t3 = time.perf_counter()
+
+        for li in range(L):
+            rows = self.grads.read_rows(li)
+            new_rows = [
+                self.opt.step_rows(path, li, row, lr, step_num,
+                                   np.dtype(self.compute_dtype),
+                                   grad_scale=scale)
+                for path, row in zip(stacked_paths, rows)]
+            self.grads.free(li)
+            self.store.write_layer(li, jax.tree_util.tree_unflatten(
+                self.store.treedef, new_rows))
+        self.store.flush_writes()
         t4 = time.perf_counter()
 
         self.last_timings = {
             "forward_stream_s": t_fwd, "backward_stream_s": t_bwd,
             "grad_finalize_s": t2 - t0 - t_fwd - t_bwd,
-            "host_adam_s": t3 - t2, "param_writeback_s": t4 - t3,
+            "host_adam_s": t3 - t2,  # resident leaves (pipelined step)
+            # stacked trunk: per-layer Adam + writeback, streamed
+            "param_writeback_s": t4 - t3,
             **{f"adam_{k}": v for k, v in
                getattr(self.opt, "last_timings", {}).items()},
         }
@@ -567,25 +709,29 @@ class ParamOffloadRunner:
             "loss_scale": 1.0,
         }
 
-    def _drain_grad(self, item, stacked_paths) -> None:
+    def _drain_grad(self, item, is_last: bool) -> None:
         li, dlayer = item
-        leaves_wp, _ = jax.tree_util.tree_flatten_with_path(dlayer)
-        for (path, g), full_path in zip(leaves_wp, stacked_paths):
-            self._stacked_grad_acc[full_path][li] += np.asarray(
-                g, np.float32)
+        self.grads.accumulate(
+            li, jax.tree_util.tree_leaves(dlayer), is_last)
 
     # -- eval / checkpoint surface -------------------------------------
     def eval_loss(self, batch) -> float:
-        """Streamed forward + loss (no grads) — evaluation under offload."""
+        """Streamed forward + loss (no grads) — evaluation under offload.
+        Uses the loss-only head jit (no resident backward / grad buffers)
+        and deterministic blocks (eval keys are unused when dropout=0 and
+        fixed when dropout>0 — evaluation never drops, matching the
+        resident engine's eval_batch)."""
         mb = jax.tree_util.tree_map(
             lambda a: jax.device_put(np.asarray(a), self._data_sh), batch)
-        x = self._jit_embed(self.resident, mb["input_ids"])
+        x = self._jit_embed(self.resident, mb)
         L = self.store.n_layer
+        zero_key = np.zeros_like(
+            np.asarray(jax.random.PRNGKey(0)))
         self.store.begin_pass(list(range(L)))
         for _ in range(L):
             _, packed = self.store.next_layer()
-            x = self._jit_block_fwd(packed, x)
-        loss, _, _ = self._jit_head_bwd(self.resident, x, mb)
+            x = self._jit_block_fwd_eval(packed, x, zero_key)
+        loss = self._jit_head_loss(self.resident, x, mb)
         return float(loss)
 
     def full_params_tree(self):

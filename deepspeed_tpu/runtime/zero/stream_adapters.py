@@ -1,0 +1,191 @@
+"""Model adapters for the streamed param-offload training path.
+
+The reference's ``remote_device="cpu"|"nvme"`` works for any module built
+under ``zero.Init`` (partition_parameters.py:616,288 — per-parameter
+hooks). The TPU streaming runner needs slightly more structure — a
+scan-stacked block to stream plus a resident embed/head — so model support
+is an adapter: anything that can express
+
+* ``split(params) -> (resident, stacked)`` / ``merge`` — which subtree
+  streams layer-by-layer,
+* ``embed_apply`` / ``head_loss`` — the resident computation around the
+  streamed trunk (must match the module's own ``__call__`` numerics
+  exactly; trajectory parity with the resident engine is asserted in
+  tests),
+* ``block_apply(layer_params, x, rng)`` — one streamed layer, with a
+  per-layer dropout rng (lifts the round-4 dropout=0 restriction: keys are
+  folded from (step, micro, layer), deterministic given the seed — note
+  the rng STREAM differs from the resident engine's ``nn.scan`` rng
+  split, so dropout>0 trains identically-distributed but not
+  bit-identically to the resident path).
+
+Supported families: ``TransformerLM`` (all presets) and
+``GPT2LMHeadModel``. ``make_adapter`` is the registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class StreamedModelAdapter:
+    """Protocol; see module docstring."""
+
+    n_layer: int
+    dropout: float
+
+    def split(self, params: Dict) -> Tuple[Dict, Any]:
+        """Full host param dict -> (resident subtree, stacked block tree
+        with leading layer axis)."""
+        resident = {k: v for k, v in params.items() if k != "blocks"}
+        return resident, params["blocks"]["block"]
+
+    def merge(self, resident: Dict, stacked) -> Dict:
+        out = dict(resident)
+        out["blocks"] = {"block": stacked}
+        return out
+
+    def embed_apply(self, resident, batch):
+        raise NotImplementedError
+
+    def block_apply(self, layer_params, x, rng, deterministic=None):
+        """One streamed layer. ``deterministic=None`` means train mode
+        (dropout active iff the config enables it); True forces eval."""
+        raise NotImplementedError
+
+    def head_loss(self, resident, xL, batch):
+        raise NotImplementedError
+
+
+class TransformerLMAdapter(StreamedModelAdapter):
+    """``models/transformer_lm.TransformerLM`` — the round-4 behavior,
+    plus dropout rng threading."""
+
+    def __init__(self, module, compute_dtype):
+        from ...models.transformer_lm import TransformerBlock
+
+        self.cfg = module.config
+        self.n_layer = self.cfg.n_layer
+        self.dropout = self.cfg.dropout
+        self.compute_dtype = compute_dtype
+        self._block = TransformerBlock(self.cfg)
+
+    def embed_apply(self, resident, batch):
+        from ...models.transformer_lm import _norm
+
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        x = jnp.take(resident["embed_tokens"]["embedding"], ids, axis=0)
+        if cfg.pos_emb == "learned":
+            pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+            x = x + jnp.take(resident["embed_pos"]["embedding"], pos, axis=0)
+        if cfg.embed_layernorm:
+            x = _norm(cfg, "embed_ln").apply(
+                {"params": resident["embed_ln"]}, x)
+        return x.astype(self.compute_dtype)
+
+    def block_apply(self, layer_params, x, rng, deterministic=None):
+        if deterministic is None:
+            deterministic = self.dropout == 0
+        rngs = None if deterministic else {"dropout": rng}
+        # TransformerBlock signature: (x, decode, deterministic)
+        return self._block.apply({"params": layer_params}, x, False,
+                                 deterministic, rngs=rngs)
+
+    def head_loss(self, resident, xL, batch):
+        from ...models.transformer_lm import _norm
+
+        cfg = self.cfg
+        # EXACTLY TransformerLM.__call__'s tail (shift + masked xent).
+        # Tied head: Embed.attend promotes both operands to cfg.dtype, so
+        # the matmul runs in compute dtype — matching it keeps bf16
+        # trajectories identical to the resident engine.
+        x = _norm(cfg, "ln_f").apply({"params": resident["ln_f"]}, xL)
+        if cfg.tie_word_embeddings:
+            emb = resident["embed_tokens"]["embedding"]
+            logits = x.astype(cfg.dtype) @ emb.T.astype(cfg.dtype)
+        else:
+            logits = x.astype(jnp.float32) @ \
+                resident["lm_head"]["kernel"].astype(jnp.float32)
+        return _shifted_xent(logits, batch)
+
+
+class GPT2Adapter(StreamedModelAdapter):
+    """``models/gpt2.GPT2LMHeadModel`` — round-5 generalization target
+    (VERDICT r4 next-#3). Resident: wte, wpe, ln_f; streamed: the scanned
+    blocks. The embed/head reuse the model's own flax submodules so the
+    numerics (including Embed.attend's dtype promotion) match
+    ``GPT2LMHeadModel.logits`` exactly."""
+
+    def __init__(self, module, compute_dtype):
+        import flax.linen as nn
+
+        from ...models.gpt2 import Block
+
+        self.cfg = module.config
+        self.n_layer = self.cfg.n_layer
+        self.dropout = self.cfg.dropout
+        self.compute_dtype = compute_dtype
+        cfg = self.cfg
+        self._block = Block(cfg)
+        self._wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype)
+        self._wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype)
+        self._ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                  dtype=cfg.dtype)
+
+    def embed_apply(self, resident, batch):
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        pos = jnp.arange(T)[None, :]
+        x = self._wte.apply({"params": resident["wte"]}, ids) + \
+            self._wpe.apply({"params": resident["wpe"]}, pos)
+        return x.astype(self.compute_dtype)
+
+    def block_apply(self, layer_params, x, rng, deterministic=None):
+        if deterministic is None:
+            deterministic = self.dropout == 0
+        rngs = None if deterministic else {"dropout": rng}
+        return self._block.apply({"params": layer_params}, x, deterministic,
+                                 rngs=rngs)
+
+    def head_loss(self, resident, xL, batch):
+        x = self._ln_f.apply({"params": resident["ln_f"]}, xL)
+        logits = self._wte.apply({"params": resident["wte"]},
+                                 x.astype(jnp.float32), method="attend")
+        return _shifted_xent(logits, batch)
+
+
+def _shifted_xent(logits, batch):
+    """The shared GPT-family tail: causal shift + masked mean xent
+    (mirrors GPT2LMHeadModel.__call__ / TransformerLM.__call__)."""
+    input_ids = batch["input_ids"]
+    labels = batch.get("labels", input_ids) if hasattr(batch, "get") \
+        else input_ids
+    logits = logits[:, :-1]
+    targets = labels[:, 1:]
+    mask = (targets >= 0).astype(jnp.float32)
+    targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_adapter(module, compute_dtype) -> StreamedModelAdapter:
+    """Adapter registry for offload_param streaming; raises with the
+    supported-family list for anything else."""
+    from ...models.gpt2 import GPT2LMHeadModel
+    from ...models.transformer_lm import TransformerLM
+
+    if isinstance(module, TransformerLM):
+        return TransformerLMAdapter(module, compute_dtype)
+    if isinstance(module, GPT2LMHeadModel):
+        return GPT2Adapter(module, compute_dtype)
+    raise ValueError(
+        "offload_param streaming supports TransformerLM and "
+        f"GPT2LMHeadModel modules (got {type(module).__name__}); the "
+        "module must expose a scan-stacked block trunk under "
+        "params['blocks']['block'] plus a resident embed/head")
