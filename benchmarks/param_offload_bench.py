@@ -160,6 +160,10 @@ def main():
                            engine._param_offload.last_timings.items()}}
         steps.append(row)
         print(f"[bench] {json.dumps(row)}", flush=True)
+        # flush partial rows every step: an hours-long tunnel-bound run
+        # that dies late must still leave a committed artifact
+        with open(args.out + ".partial", "w") as f:
+            json.dump({"steps": steps}, f, indent=1)
 
     losses = [s["loss"] for s in steps]
     decreasing = all(b < a for a, b in zip(losses, losses[1:]))

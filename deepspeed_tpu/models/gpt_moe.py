@@ -39,6 +39,10 @@ class GPTMoEConfig:
     k: int = 1                         # top-k gating
     capacity_factor: float = 1.25
     drop_tokens: bool = True
+    # random token selection when dropping at capacity (the reference's
+    # use_rts, sharded_moe.py: breaks position bias; draws the "gating"
+    # rng in train mode). False = deterministic position-order dropping
+    use_rts: bool = True
     aux_loss_weight: float = 0.01
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-5
@@ -75,7 +79,8 @@ class _Block(nn.Module):
             moe_out, aux, _ = MoE(
                 hidden_size=cfg.n_embd, num_experts=self.num_experts,
                 k=cfg.k, capacity_factor=cfg.capacity_factor,
-                drop_tokens=cfg.drop_tokens, name="moe")(
+                drop_tokens=cfg.drop_tokens, use_rts=cfg.use_rts,
+                name="moe")(
                     ln2(x), deterministic=deterministic)
             x = x + moe_out
         else:

@@ -54,23 +54,28 @@ class AioHandle:
         self.queue_depth = queue_depth
         self.num_threads = num_threads
         self.o_direct = o_direct
-        self._refs = []  # keep submitted buffers alive until wait()
+        # submitted buffers stay alive until their ticket completes (or a
+        # full wait()): keyed per ticket so long-running per-ticket users
+        # (the layer-streamed finalize) do not accumulate O(model) refs
+        self._refs = {}
 
     def async_pwrite(self, array: np.ndarray, path: str,
                      offset: int = 0) -> int:
         """Submit; returns a completion ticket for ``wait_ticket``."""
         a = np.ascontiguousarray(array)
-        self._refs.append(a)
-        return self._lib.ds_aio_pwrite(self._h, os.fsencode(path),
-                                       a.ctypes.data, a.nbytes, offset)
+        t = self._lib.ds_aio_pwrite(self._h, os.fsencode(path),
+                                    a.ctypes.data, a.nbytes, offset)
+        self._refs[t] = a
+        return t
 
     def async_pread(self, array: np.ndarray, path: str,
                     offset: int = 0) -> int:
         """Submit; returns a completion ticket for ``wait_ticket``."""
         assert array.flags["C_CONTIGUOUS"] and array.flags["WRITEABLE"]
-        self._refs.append(array)
-        return self._lib.ds_aio_pread(self._h, os.fsencode(path),
-                                      array.ctypes.data, array.nbytes, offset)
+        t = self._lib.ds_aio_pread(self._h, os.fsencode(path),
+                                   array.ctypes.data, array.nbytes, offset)
+        self._refs[t] = array
+        return t
 
     # reference-named blocking variants (deepspeed_py_aio_handle's sync_*
     # calls return only after the I/O completes)
@@ -94,8 +99,9 @@ class AioHandle:
     def wait_ticket(self, ticket: int) -> None:
         """Blocks until ONE submitted request completes (the pipelined
         swap-in path: wait for a leaf's read while later leaves keep
-        streaming). Buffers stay referenced until a full ``wait()``."""
+        streaming); releases that ticket's buffer reference."""
         errors = self._lib.ds_aio_wait_ticket(self._h, ticket)
+        self._refs.pop(ticket, None)
         if errors:
             raise IOError(f"aio: {errors} chunk(s) failed (ticket {ticket})")
 

@@ -80,6 +80,13 @@ class OffloadedOptimizer:
             from ...ops.aio import AioHandle
 
             self.nvme_dir = config.nvme_path or "/tmp/ds_tpu_nvme"
+            import jax as _jax
+
+            if _jax.process_count() > 1:
+                # rank-namespace: leaf files are rank-agnostic names and
+                # same-host processes must not clobber each other's state
+                self.nvme_dir = os.path.join(
+                    self.nvme_dir, f"rank{_jax.process_index()}")
             os.makedirs(self.nvme_dir, exist_ok=True)
             ac = self._aio_config
             # aio.thread_count only overrides the historical buffer_count
@@ -258,6 +265,16 @@ class OffloadedOptimizer:
         return True
 
     # --- per-row (layer-streamed) step ----------------------------------
+    _row_pending: list = None
+
+    def drain_row_writes(self) -> None:
+        """Wait all deferred step_rows writes (per-ticket; the handle may
+        be shared). The streamed finalize calls this once per LAYER."""
+        pending, self._row_pending = self._row_pending or [], []
+        for tickets, _bufs in pending:
+            for t in tickets:
+                self._aio.wait_ticket(t)
+
     def step_rows(self, key: str, row: int, grad_row: np.ndarray, lr: float,
                   step_num: int, compute_dtype, grad_scale: float = 1.0
                   ) -> np.ndarray:
@@ -278,6 +295,8 @@ class OffloadedOptimizer:
         g = np.ascontiguousarray(np.asarray(grad_row, np.float32)).ravel()
         if grad_scale != 1.0:
             g = g * np.float32(grad_scale)
+        if self._row_pending is None:
+            self._row_pending = []
         swapped = self.nvme and self.m[key] is None
         if swapped:
             m = self._alloc(n)
@@ -298,12 +317,19 @@ class OffloadedOptimizer:
             master = self.master[key].reshape(-1)[row * n:(row + 1) * n]
         self.opt.step(master, g, m, v, step_num, lr=lr)
         if swapped:
-            self._aio.async_pwrite(m, self._leaf_file(key, "m"), off)
-            self._aio.async_pwrite(v, self._leaf_file(key, "v"), off)
+            # submit writes and DEFER the drain: buffers stay referenced in
+            # _row_pending until drain_row_writes() (called once per layer
+            # by the streamed finalize), so row i's writes overlap row
+            # i+1's reads/Adam instead of serializing per row
+            tickets = [
+                self._aio.async_pwrite(m, self._leaf_file(key, "m"), off),
+                self._aio.async_pwrite(v, self._leaf_file(key, "v"), off)]
+            bufs = [m, v]
             if self.swap_master:
-                self._aio.async_pwrite(master,
-                                       self._leaf_file(key, "master"), off)
-            self._aio.wait()
+                tickets.append(self._aio.async_pwrite(
+                    master, self._leaf_file(key, "master"), off))
+                bufs.append(master)
+            self._row_pending.append((tickets, bufs))
         if compute_dtype is not None and \
                 np.dtype(compute_dtype) == np.dtype(ml_dtypes.bfloat16):
             new_row = self.opt.to_bf16(master)

@@ -74,6 +74,70 @@ def _writable_tree(tree):
         and a.flags.writeable else np.array(a), tree)
 
 
+def _rank_dir(path: str) -> str:
+    """Rank-namespace an NVMe directory under multi-process launch: the
+    per-layer param/grad files and optimizer leaf files are rank-agnostic
+    names, and two same-host processes sharing one dir would read each
+    other's half-written files (no cross-rank barrier inside the
+    finalize). Each process keeps its own full replica, same as the cpu
+    tier's host arrays."""
+    if jax.process_count() > 1:
+        import os
+
+        return os.path.join(path, f"rank{jax.process_index()}")
+    return path
+
+
+def _make_aio(aio_config, target_dir):
+    """Shared AioHandle construction (LayerParamStore + HeteroLayerStore):
+    thread sizing from the aio config, O_DIRECT when the filesystem
+    supports it (DS_AIO_NO_ODIRECT=1 forces buffered)."""
+    import os
+
+    from ...ops.aio import AioHandle, o_direct_supported
+
+    use_od = os.environ.get("DS_AIO_NO_ODIRECT") != "1" and \
+        o_direct_supported(target_dir)
+    ac = aio_config
+    return AioHandle(
+        num_threads=max(1, ac.thread_count if ac else 2),
+        block_size=ac.block_size if ac else 1 << 20,
+        queue_depth=ac.queue_depth if ac else 0,
+        o_direct=use_od,
+        single_submit=ac.single_submit if ac else False,
+        overlap_events=ac.overlap_events if ac else True)
+
+
+class _PackedWriteBuffers:
+    """Double-buffered pack-and-write pair shared by both layer stores:
+    packing layer i+1 overlaps the async write of layer i; the ticket for
+    a half is drained only when that half is reused (or at flush)."""
+
+    def __init__(self, aio, nbytes: int):
+        from ...ops.aio import aligned_array
+
+        self._aio = aio
+        self._bufs = [aligned_array(nbytes) for _ in range(2)]
+        self._tickets: List[Optional[int]] = [None, None]
+        self._turn = 0
+
+    def write(self, nbytes: int, fill, path: str) -> None:
+        turn = self._turn
+        if self._tickets[turn] is not None:
+            self._aio.wait_ticket(self._tickets[turn])
+            self._tickets[turn] = None
+        buf = self._bufs[turn][:nbytes]
+        fill(buf)
+        self._tickets[turn] = self._aio.async_pwrite(buf, path)
+        self._turn = 1 - turn
+
+    def flush(self) -> None:
+        for t, ticket in enumerate(self._tickets):
+            if ticket is not None:
+                self._aio.wait_ticket(ticket)
+                self._tickets[t] = None
+
+
 def check_supported(engine) -> None:
     """Fail at initialize() with actionable messages (mirrors the onebit
     wire's up-front validation). Round 5: model support went through the
@@ -96,13 +160,11 @@ def check_supported(engine) -> None:
             mesh_mod.get_pipe_parallel_world_size() > 1:
         raise ValueError("offload_param streaming composes with data "
                          "parallelism only (mp=sp=pp=1)")
-    import jax as _jax
-
-    if _jax.process_count() > 1:
-        raise ValueError(
-            "offload_param streaming is single-process (multi-device DP via "
-            "GSPMD is supported; the host-side grad buffers are not yet "
-            "reduced across processes)")
+    # multi-process DP is supported (round 5): the per-layer grads carry a
+    # replicated out-sharding over the GLOBAL mesh, so XLA's cross-replica
+    # (cross-process) reduction runs before the D2H drain — every process
+    # accumulates identical reduced grads and the per-process host Adam
+    # stays in lockstep (asserted by tests/unit/comm/test_multiprocess.py)
     if engine._config.compression_training:
         raise ValueError("offload_param does not compose with compression "
                          "training (params are not device-resident)")
@@ -139,28 +201,16 @@ class LayerParamStore:
         if self.nvme:
             import os
 
-            from ...ops.aio import AioHandle, aligned_array, o_direct_supported
+            from ...ops.aio import aligned_array
 
-            self.dir = nvme_dir or "/tmp/ds_tpu_param_nvme"
+            self.dir = _rank_dir(nvme_dir or "/tmp/ds_tpu_param_nvme")
             os.makedirs(self.dir, exist_ok=True)
-            use_od = os.environ.get("DS_AIO_NO_ODIRECT") != "1" and \
-                o_direct_supported(self.dir)
-            ac = aio_config
-            self._aio = AioHandle(
-                num_threads=max(1, ac.thread_count if ac else 2),
-                block_size=ac.block_size if ac else 1 << 20,
-                queue_depth=ac.queue_depth if ac else 0,
-                o_direct=use_od,
-                single_submit=ac.single_submit if ac else False,
-                overlap_events=ac.overlap_events if ac else True)
-            # O_DIRECT-compatible staging buffers + a double-buffered pack
-            # pair: packing layer i+1 overlaps the write of layer i
+            self._aio = _make_aio(aio_config, self.dir)
+            # O_DIRECT-compatible staging buffers + the shared
+            # double-buffered pack pair
             self._staging = [aligned_array(self.layer_nbytes)
                              for _ in range(n_slots)]
-            self._packbufs = [aligned_array(self.layer_nbytes)
-                              for _ in range(2)]
-            self._pack_tickets: List[Optional[int]] = [None, None]
-            self._pack_turn = 0
+            self._packer = _PackedWriteBuffers(self._aio, self.layer_nbytes)
             self.stacked = None
             self._write_all_layers(stacked_host)
         else:
@@ -196,23 +246,13 @@ class LayerParamStore:
                 np.copyto(dst[i], np.asarray(src).astype(dst.dtype,
                                                          copy=False))
             return
-        turn = self._pack_turn
-        if self._pack_tickets[turn] is not None:
-            self._aio.wait_ticket(self._pack_tickets[turn])
-            self._pack_tickets[turn] = None
-        buf = self._packbufs[turn]
-        self._pack_into(layer_tree, buf)
-        self._pack_tickets[turn] = self._aio.async_pwrite(
-            buf, self._layer_file(i))
-        self._pack_turn = 1 - turn
+        self._packer.write(self.layer_nbytes,
+                           lambda buf: self._pack_into(layer_tree, buf),
+                           self._layer_file(i))
 
     def flush_writes(self) -> None:
-        if not self.nvme:
-            return
-        for t, ticket in enumerate(self._pack_tickets):
-            if ticket is not None:
-                self._aio.wait_ticket(ticket)
-                self._pack_tickets[t] = None
+        if self.nvme:
+            self._packer.flush()
 
     def _write_all_layers(self, stacked) -> None:
         """(Re)write every per-layer NVMe file from a stacked host tree
@@ -323,6 +363,162 @@ class LayerParamStore:
         return jax.tree_util.tree_unflatten(self.treedef, out_leaves)
 
 
+class HeteroLayerStore:
+    """Per-layer param store for models whose layers DIFFER in structure
+    (gpt_moe: alternating dense / MoE blocks — a Python loop, not
+    ``nn.scan``). Same streaming discipline as :class:`LayerParamStore`
+    (rotating staging slots, NVMe read-ahead, double-buffered writeback)
+    with one :class:`LayerWireFormat` per layer KIND; ``next_layer``
+    additionally yields the kind so the runner picks the matching jitted
+    block function."""
+
+    def __init__(self, layers_host: List, compute_dtype,
+                 device: OffloadDeviceEnum, nvme_dir: Optional[str] = None,
+                 aio_config=None, prefetch: int = 1):
+        self.n_layer = len(layers_host)
+        self.prefetch = max(0, prefetch)
+        self.nvme = device == OffloadDeviceEnum.nvme
+
+        # group layers by structural signature -> kinds
+        self.kind_of: List[int] = []
+        self.wires: List[LayerWireFormat] = []
+        sig_to_kind: Dict[Any, int] = {}
+        for tree in layers_host:
+            leaves_wp, treedef = jax.tree_util.tree_flatten(tree)
+            sig = (treedef, tuple((np.shape(a), str(np.asarray(a).dtype))
+                                  for a in leaves_wp))
+            if sig not in sig_to_kind:
+                sig_to_kind[sig] = len(self.wires)
+                self.wires.append(LayerWireFormat(tree, compute_dtype))
+            self.kind_of.append(sig_to_kind[sig])
+        self.max_nbytes = max(w.total_nbytes for w in self.wires)
+
+        n_slots = self.prefetch + 2
+        self._staging_dev: List[Optional[jax.Array]] = [None] * n_slots
+        self._aio = None
+        if self.nvme:
+            import os
+
+            from ...ops.aio import aligned_array
+
+            self.dir = _rank_dir(nvme_dir or "/tmp/ds_tpu_param_nvme")
+            os.makedirs(self.dir, exist_ok=True)
+            self._aio = _make_aio(aio_config, self.dir)
+            self._staging = [aligned_array(self.max_nbytes)
+                             for _ in range(n_slots)]
+            self._packer = _PackedWriteBuffers(self._aio, self.max_nbytes)
+            self.layers = None
+            for i, tree in enumerate(layers_host):
+                self.write_layer(i, tree)
+            self.flush_writes()
+        else:
+            self._staging = [np.empty(self.max_nbytes, np.uint8)
+                             for _ in range(n_slots)]
+            self.layers = [_writable_tree(t) for t in layers_host]
+        self._order: List[int] = []
+        self._pos = 0
+        self._tickets: Dict[int, Any] = {}
+        self._slot_of: Dict[int, int] = {}
+
+    def _layer_file(self, i: int) -> str:
+        import os
+
+        return os.path.join(self.dir, f"layer_{i:05d}.bin")
+
+    def unpack(self, kind: int, flat):
+        w = self.wires[kind]
+        if w.uniform_dtype is not None:
+            return w.unpack_typed(flat)
+        return w.unpack(flat)
+
+    def begin_pass(self, order: List[int]) -> None:
+        assert not self._tickets, "previous pass not drained"
+        self._order = list(order)
+        self._pos = 0
+        self._slot_of = {}
+        if self.nvme:
+            for j in range(min(self.prefetch + 1, len(self._order))):
+                self._submit_read(j)
+
+    def _submit_read(self, pos: int) -> None:
+        i = self._order[pos]
+        slot = pos % len(self._staging)
+        prev = self._staging_dev[slot]
+        if prev is not None:
+            prev.block_until_ready()
+            self._staging_dev[slot] = None
+        self._slot_of[i] = slot
+        nbytes = self.wires[self.kind_of[i]].total_nbytes
+        self._tickets[i] = self._aio.async_pread(
+            self._staging[slot][:nbytes], self._layer_file(i))
+
+    def next_layer(self):
+        """(layer_index, kind, packed device buffer) in declared order."""
+        pos = self._pos
+        i = self._order[pos]
+        kind = self.kind_of[i]
+        w = self.wires[kind]
+        self._pos += 1
+        if self.nvme:
+            slot = self._slot_of.pop(i)
+            self._aio.wait_ticket(self._tickets.pop(i))
+            nxt = pos + self.prefetch + 1
+            if nxt < len(self._order):
+                self._submit_read(nxt)
+        else:
+            slot = pos % len(self._staging)
+            prev = self._staging_dev[slot]
+            if prev is not None:
+                prev.block_until_ready()
+                self._staging_dev[slot] = None
+            w.pack_into(self.layers[i], self._staging[slot][:w.total_nbytes])
+        for s, dev in enumerate(self._staging_dev):
+            if dev is not None and s != slot:
+                try:
+                    if dev.is_ready():
+                        self._staging_dev[s] = None
+                except AttributeError:
+                    break
+        buf = self._staging[slot][:w.total_nbytes]
+        if w.uniform_dtype is not None:
+            buf = buf.view(w.uniform_dtype)
+        payload = buf.copy() if jax.default_backend() == "cpu" else buf
+        dev = jax.device_put(payload)
+        self._staging_dev[slot] = dev
+        return i, kind, dev
+
+    def write_layer(self, i: int, layer_tree) -> None:
+        if not self.nvme:
+            for dst, src in zip(jax.tree_util.tree_leaves(self.layers[i]),
+                                jax.tree_util.tree_leaves(layer_tree)):
+                np.copyto(dst, np.asarray(src).astype(dst.dtype, copy=False))
+            return
+        w = self.wires[self.kind_of[i]]
+        self._packer.write(w.total_nbytes,
+                           lambda buf: w.pack_into(layer_tree, buf),
+                           self._layer_file(i))
+
+    def flush_writes(self) -> None:
+        if self.nvme:
+            self._packer.flush()
+
+    def materialize_layers(self) -> List:
+        """All layers as host trees (checkpoint surface)."""
+        if not self.nvme:
+            return list(self.layers)
+        from ...ops.aio import aligned_array
+
+        out = []
+        buf = aligned_array(self.max_nbytes)
+        for i in range(self.n_layer):
+            w = self.wires[self.kind_of[i]]
+            t = self._aio.async_pread(buf[:w.total_nbytes],
+                                      self._layer_file(i))
+            self._aio.wait_ticket(t)
+            out.append(w.unpack_host(buf[:w.total_nbytes]))
+        return out
+
+
 class GradRowStore:
     """Per-layer gradient accumulation for the streamed backward.
 
@@ -335,12 +531,19 @@ class GradRowStore:
     host memory stays O(layer) for the entire step."""
 
     def __init__(self, n_layer: int, leaf_shapes, nvme_dir: Optional[str],
-                 aio=None):
+                 aio=None, per_layer_shapes=None):
+        """``leaf_shapes``: shared per-layer leaf shapes (scan-stacked
+        models); ``per_layer_shapes`` overrides with one shape list PER
+        layer (heterogeneous models, e.g. alternating dense/MoE blocks)."""
         self.n_layer = n_layer
-        self.leaf_shapes = list(leaf_shapes)
-        self._sizes = [int(np.prod(s)) if s else 1 for s in self.leaf_shapes]
-        self._offsets = np.cumsum([0] + self._sizes)
-        self.total = int(self._offsets[-1])
+        if per_layer_shapes is None:
+            per_layer_shapes = [list(leaf_shapes)] * n_layer
+        self._layer_shapes = [list(s) for s in per_layer_shapes]
+        self._layer_sizes = [[int(np.prod(s)) if s else 1 for s in shapes]
+                             for shapes in self._layer_shapes]
+        self._layer_offsets = [np.cumsum([0] + sizes)
+                               for sizes in self._layer_sizes]
+        self._layer_total = [int(off[-1]) for off in self._layer_offsets]
         self.nvme = nvme_dir is not None
         self.sq: Dict[int, float] = {}
         if self.nvme:
@@ -351,7 +554,8 @@ class GradRowStore:
             self.dir = os.path.join(nvme_dir, "grads")
             os.makedirs(self.dir, exist_ok=True)
             self._aio = aio
-            self._buf = aligned_array(self.total * 4).view(np.float32)
+            self._buf = aligned_array(
+                max(self._layer_total) * 4).view(np.float32)
             self._have: set = set()
         else:
             self.rows: Dict[int, Optional[np.ndarray]] = {}
@@ -361,22 +565,24 @@ class GradRowStore:
 
         return os.path.join(self.dir, f"grad_{li:05d}.bin")
 
-    def _pack(self, leaves, out: np.ndarray) -> None:
-        for off, size, leaf in zip(self._offsets, self._sizes, leaves):
+    def _pack(self, li: int, leaves, out: np.ndarray) -> None:
+        for off, size, leaf in zip(self._layer_offsets[li],
+                                   self._layer_sizes[li], leaves):
             out[off:off + size] = np.asarray(leaf, np.float32).ravel()
 
     def accumulate(self, li: int, leaves, is_last: bool) -> None:
         """Add one micro batch's fp32 grad rows for layer ``li``; on the
         last micro also record the layer's sum of squares."""
+        total = self._layer_total[li]
         if not self.nvme:
             flat = self.rows.get(li)
             if flat is None:
-                flat = np.empty(self.total, np.float32)
-                self._pack(leaves, flat)
+                flat = np.empty(total, np.float32)
+                self._pack(li, leaves, flat)
                 self.rows[li] = flat
             else:
-                for off, size, leaf in zip(self._offsets, self._sizes,
-                                           leaves):
+                for off, size, leaf in zip(self._layer_offsets[li],
+                                           self._layer_sizes[li], leaves):
                     flat[off:off + size] += np.asarray(
                         leaf, np.float32).ravel()
             if is_last:
@@ -386,18 +592,20 @@ class GradRowStore:
         # LayerParamStore — a handle-global wait() here would drain the
         # store's in-flight layer prefetches / pack writes and serialize
         # the streaming pipeline
+        buf = self._buf[:total]
         if li in self._have:
-            t = self._aio.async_pread(self._buf, self._file(li))
+            t = self._aio.async_pread(buf, self._file(li))
             self._aio.wait_ticket(t)
-            for off, size, leaf in zip(self._offsets, self._sizes, leaves):
-                self._buf[off:off + size] += np.asarray(
+            for off, size, leaf in zip(self._layer_offsets[li],
+                                       self._layer_sizes[li], leaves):
+                buf[off:off + size] += np.asarray(
                     leaf, np.float32).ravel()
         else:
-            self._pack(leaves, self._buf)
+            self._pack(li, leaves, buf)
             self._have.add(li)
         if is_last:
-            self.sq[li] = float(np.dot(self._buf, self._buf))
-        t = self._aio.async_pwrite(self._buf, self._file(li))
+            self.sq[li] = float(np.dot(buf, buf))
+        t = self._aio.async_pwrite(buf, self._file(li))
         self._aio.wait_ticket(t)
 
     def total_sq(self) -> float:
@@ -408,12 +616,13 @@ class GradRowStore:
         if not self.nvme:
             flat = self.rows[li]
         else:
-            t = self._aio.async_pread(self._buf, self._file(li))
+            flat = self._buf[:self._layer_total[li]]
+            t = self._aio.async_pread(flat, self._file(li))
             self._aio.wait_ticket(t)  # shared handle: no global wait
-            flat = self._buf
         return [flat[off:off + size].reshape(shape)
-                for off, size, shape in zip(self._offsets, self._sizes,
-                                            self.leaf_shapes)]
+                for off, size, shape in zip(self._layer_offsets[li],
+                                            self._layer_sizes[li],
+                                            self._layer_shapes[li])]
 
     def free(self, li: int) -> None:
         if not self.nvme:
@@ -472,11 +681,21 @@ class ParamOffloadRunner:
                                       aio_config=engine._config.aio)
 
         # split the tree: resident (device) vs streamed (store)
-        self._resident_host, stacked = self.adapter.split(params_host)
-        self.store = LayerParamStore(
-            stacked, cfg.n_layer, self.compute_dtype, self.op_cfg.device,
-            nvme_dir=self.op_cfg.nvme_path, aio_config=engine._config.aio,
-            prefetch=max(1, min(self.op_cfg.buffer_count - 1, 4)))
+        self.hetero = getattr(self.adapter, "heterogeneous", False)
+        self.has_aux = getattr(self.adapter, "has_aux", False)
+        self._resident_host, streamed = self.adapter.split(params_host)
+        if self.hetero:
+            self.store = HeteroLayerStore(
+                streamed, self.compute_dtype, self.op_cfg.device,
+                nvme_dir=self.op_cfg.nvme_path,
+                aio_config=engine._config.aio,
+                prefetch=max(1, min(self.op_cfg.buffer_count - 1, 4)))
+        else:
+            self.store = LayerParamStore(
+                streamed, cfg.n_layer, self.compute_dtype,
+                self.op_cfg.device, nvme_dir=self.op_cfg.nvme_path,
+                aio_config=engine._config.aio,
+                prefetch=max(1, min(self.op_cfg.buffer_count - 1, 4)))
 
         rep = NamedSharding(self.mesh, PartitionSpec())
         self._rep = rep
@@ -495,39 +714,43 @@ class ParamOffloadRunner:
         self.resident = to_dev(self._resident_host)
 
         adapter = self.adapter
-        unpack = self.store.unpack
 
         # ---- jitted pieces (each reused for every layer/micro) --------
-        def block_fwd(packed, x, rng):
-            return adapter.block_apply(unpack(packed), x, rng)
+        if self.hetero:
+            self._build_hetero_block_fns(rep)
+        else:
+            unpack = self.store.unpack
 
-        self._jit_block_fwd = jax.jit(
-            block_fwd, out_shardings=self._data_sh)
+            def block_fwd(packed, x, rng):
+                return adapter.block_apply(unpack(packed), x, rng)
 
-        def block_fwd_eval(packed, x, rng):
-            return adapter.block_apply(unpack(packed), x, rng,
-                                       deterministic=True)
+            self._jit_block_fwd = jax.jit(
+                block_fwd, out_shardings=self._data_sh)
 
-        self._jit_block_fwd_eval = jax.jit(
-            block_fwd_eval, out_shardings=self._data_sh)
+            def block_fwd_eval(packed, x, rng):
+                return adapter.block_apply(unpack(packed), x, rng,
+                                           deterministic=True)
 
-        def block_bwd(packed, x, dy, rng):
-            layer = unpack(packed)
+            self._jit_block_fwd_eval = jax.jit(
+                block_fwd_eval, out_shardings=self._data_sh)
 
-            def f(lp, xi):
-                return adapter.block_apply(lp, xi, rng)
+            def block_bwd(packed, x, dy, rng):
+                layer = unpack(packed)
 
-            _, vjp = jax.vjp(f, layer, x)
-            dlayer, dx = vjp(dy)
-            return dx, dlayer
+                def f(lp, xi):
+                    return adapter.block_apply(lp, xi, rng)
 
-        grad_rep = jax.tree_util.tree_map(
-            lambda _: rep,
-            jax.tree_util.tree_unflatten(
-                self.store.treedef,
-                [0] * len(self.store.leaf_shapes)))
-        self._jit_block_bwd = jax.jit(
-            block_bwd, out_shardings=(self._data_sh, grad_rep))
+                _, vjp = jax.vjp(f, layer, x)
+                dlayer, dx = vjp(dy)
+                return dx, dlayer
+
+            grad_rep = jax.tree_util.tree_map(
+                lambda _: rep,
+                jax.tree_util.tree_unflatten(
+                    self.store.treedef,
+                    [0] * len(self.store.leaf_shapes)))
+            self._jit_block_bwd = jax.jit(
+                block_bwd, out_shardings=(self._data_sh, grad_rep))
 
         def embed_fwd(resident, batch):
             return adapter.embed_apply(resident, batch)
@@ -565,12 +788,21 @@ class ParamOffloadRunner:
         # per-layer grad accumulation: DRAM rows (cpu tier) or per-layer
         # NVMe files (nvme tier — the ZeRO-Infinity gradient-swap analog,
         # O(layer) host DRAM for the whole step)
-        self.grads = GradRowStore(
-            self.store.n_layer, self.store.leaf_shapes,
-            self.store.dir if self.store.nvme else None,
-            aio=self.store._aio)
+        if self.hetero:
+            self.grads = GradRowStore(
+                self.store.n_layer, None,
+                self.store.dir if self.store.nvme else None,
+                aio=self.store._aio,
+                per_layer_shapes=[
+                    self.store.wires[k].shapes for k in self.store.kind_of])
+        else:
+            self.grads = GradRowStore(
+                self.store.n_layer, self.store.leaf_shapes,
+                self.store.dir if self.store.nvme else None,
+                aio=self.store._aio)
         self.last_timings: Dict[str, float] = {}
-        nbytes = self.store.layer_nbytes
+        nbytes = self.store.max_nbytes if self.hetero \
+            else self.store.layer_nbytes
         log_dist(
             f"ZeRO param offload: device={self.op_cfg.device} "
             f"{cfg.n_layer} layers x {nbytes / 1e6:.1f} MB streamed, "
@@ -579,6 +811,63 @@ class ParamOffloadRunner:
                else " (moments-only swap)"), ranks=[0])
 
     # -- helpers -------------------------------------------------------
+    def _build_hetero_block_fns(self, rep_sharding):
+        """One jitted fwd/bwd/eval per structural KIND (dense vs each MoE
+        shape) — layers of the same kind share the compiled program. Block
+        outputs are ``(x, aux)``; the bwd vjp receives ``aux_weight`` as
+        the aux cotangent so router grads match the resident engine."""
+        adapter = self.adapter
+        store = self.store
+        aux_ct = jnp.asarray(getattr(adapter, "aux_weight", 0.0),
+                             jnp.float32)
+        rep_layer = {}
+        for i, k in enumerate(store.kind_of):
+            rep_layer.setdefault(k, i)
+        self._jit_block_fwd_k = {}
+        self._jit_block_fwd_eval_k = {}
+        self._jit_block_bwd_k = {}
+        for k, ri in rep_layer.items():
+            def fwd(packed, x, rng, _k=k, _ri=ri):
+                return adapter.block_apply_layer(
+                    _ri, store.unpack(_k, packed), x, rng)
+
+            def fwd_eval(packed, x, rng, _k=k, _ri=ri):
+                return adapter.block_apply_layer(
+                    _ri, store.unpack(_k, packed), x, rng,
+                    deterministic=True)
+
+            def bwd(packed, x, dy, rng, _k=k, _ri=ri):
+                layer = store.unpack(_k, packed)
+
+                def f(lp, xi):
+                    return adapter.block_apply_layer(_ri, lp, xi, rng)
+
+                _, vjp = jax.vjp(f, layer, x)
+                dlayer, dx = vjp((dy, aux_ct))
+                return dx, dlayer
+
+            grad_rep = jax.tree_util.tree_map(
+                lambda _: rep_sharding,
+                jax.tree_util.tree_unflatten(
+                    store.wires[k].treedef,
+                    [0] * len(store.wires[k].shapes)))
+            self._jit_block_fwd_k[k] = jax.jit(
+                fwd, out_shardings=(self._data_sh, rep_sharding))
+            self._jit_block_fwd_eval_k[k] = jax.jit(
+                fwd_eval, out_shardings=(self._data_sh, rep_sharding))
+            self._jit_block_bwd_k[k] = jax.jit(
+                bwd, out_shardings=(self._data_sh, grad_rep))
+
+    def _layer_paths(self, i: int):
+        """Canonical flat param paths of heterogeneous layer ``i``."""
+        kind = self.store.kind_of[i]
+        w = self.store.wires[kind]
+        leaves_wp, _ = jax.tree_util.tree_flatten_with_path(
+            jax.tree_util.tree_unflatten(w.treedef,
+                                         list(range(len(w.shapes)))))
+        prefix = self.adapter.layer_key(i) + "/"
+        return [prefix + _path_str(p) for p, _ in leaves_wp]
+
     def _stacked_paths(self):
         """Canonical flat path prefix for stacked leaves."""
         leaves_wp, _ = jax.tree_util.tree_flatten_with_path(
@@ -594,7 +883,8 @@ class ParamOffloadRunner:
         t0 = time.perf_counter()
         self.grads.reset()
         L = self.store.n_layer
-        stacked_paths = self._stacked_paths()
+        stacked_paths = None if self.hetero else self._stacked_paths()
+        aux_sum = 0.0
         res_grad_acc = None
         loss_sum = 0.0
         t_fwd = t_bwd = 0.0
@@ -613,10 +903,18 @@ class ParamOffloadRunner:
             tf0 = time.perf_counter()
             x = self._jit_embed(self.resident, mb)
             acts = [x]
+            micro_aux = []  # device scalars; fetched with the loss below
             self.store.begin_pass(list(range(L)))
             for li in range(L):
-                _, packed = self.store.next_layer()
-                x = self._jit_block_fwd(packed, x, np_keys[mi, li])
+                if self.hetero:
+                    _, kind, packed = self.store.next_layer()
+                    x, aux = self._jit_block_fwd_k[kind](
+                        packed, x, np_keys[mi, li])
+                    if self.has_aux:
+                        micro_aux.append(aux)
+                else:
+                    _, packed = self.store.next_layer()
+                    x = self._jit_block_fwd(packed, x, np_keys[mi, li])
                 acts.append(x)
             loss, dres_head, dy = self._jit_head_bwd(
                 self.resident, acts[-1], mb)
@@ -627,9 +925,14 @@ class ParamOffloadRunner:
             pending = deque()  # (layer, dlayer) with D2H in flight
             self.store.begin_pass(list(range(L - 1, -1, -1)))
             for li in range(L - 1, -1, -1):
-                _, packed = self.store.next_layer()
-                dy, dlayer = self._jit_block_bwd(packed, acts[li], dy,
-                                                 np_keys[mi, li])
+                if self.hetero:
+                    _, kind, packed = self.store.next_layer()
+                    dy, dlayer = self._jit_block_bwd_k[kind](
+                        packed, acts[li], dy, np_keys[mi, li])
+                else:
+                    _, packed = self.store.next_layer()
+                    dy, dlayer = self._jit_block_bwd(packed, acts[li], dy,
+                                                     np_keys[mi, li])
                 acts[li + 1] = None  # free the boundary activation
                 for g in jax.tree_util.tree_leaves(dlayer):
                     g.copy_to_host_async()
@@ -643,6 +946,13 @@ class ParamOffloadRunner:
             res_grad_acc = dres if res_grad_acc is None else \
                 self._acc_add(res_grad_acc, dres)
             loss_sum += float(loss)
+            if self.has_aux and micro_aux:
+                # engine tuple-return convention: metric = loss + w * aux;
+                # sum on device (scalar adds), ONE host fetch per micro
+                aux_dev = micro_aux[0]
+                for a in micro_aux[1:]:
+                    aux_dev = self._acc_add(aux_dev, a)
+                aux_sum += self.adapter.aux_weight * float(aux_dev)
             acts = None
             t_bwd += time.perf_counter() - tb0
 
@@ -681,15 +991,31 @@ class ParamOffloadRunner:
 
         for li in range(L):
             rows = self.grads.read_rows(li)
+            if self.hetero:
+                # per-layer param subtrees: whole-leaf pipelined step over
+                # just this layer's keys (same O(layer) discipline)
+                paths = self._layer_paths(li)
+                new_flat = self.opt.step(
+                    dict(zip(paths, rows)), lr, step_num,
+                    np.dtype(self.compute_dtype), grad_scale=scale,
+                    release_grads=True, keys=set(paths))
+                self.grads.free(li)
+                kind = self.store.kind_of[li]
+                self.store.write_layer(li, jax.tree_util.tree_unflatten(
+                    self.store.wires[kind].treedef,
+                    [new_flat[p] for p in paths]))
+                continue
             new_rows = [
                 self.opt.step_rows(path, li, row, lr, step_num,
                                    np.dtype(self.compute_dtype),
                                    grad_scale=scale)
                 for path, row in zip(stacked_paths, rows)]
+            self.opt.drain_row_writes()  # one drain per layer, not per row
             self.grads.free(li)
             self.store.write_layer(li, jax.tree_util.tree_unflatten(
                 self.store.treedef, new_rows))
         self.store.flush_writes()
+        self.opt.drain_row_writes()
         t4 = time.perf_counter()
 
         self.last_timings = {
@@ -702,7 +1028,7 @@ class ParamOffloadRunner:
                getattr(self.opt, "last_timings", {}).items()},
         }
         return {
-            "loss": loss_sum * inv_gas,
+            "loss": (loss_sum + aux_sum) * inv_gas,
             "grad_norm": grad_norm,
             "lr": lr,
             "overflow": False,
@@ -727,18 +1053,33 @@ class ParamOffloadRunner:
         L = self.store.n_layer
         zero_key = np.zeros_like(
             np.asarray(jax.random.PRNGKey(0)))
+        aux_dev = None
         self.store.begin_pass(list(range(L)))
         for _ in range(L):
-            _, packed = self.store.next_layer()
-            x = self._jit_block_fwd_eval(packed, x, zero_key)
-        loss = self._jit_head_loss(self.resident, x, mb)
-        return float(loss)
+            if self.hetero:
+                _, kind, packed = self.store.next_layer()
+                x, aux = self._jit_block_fwd_eval_k[kind](packed, x,
+                                                          zero_key)
+                if self.has_aux:
+                    aux_dev = aux if aux_dev is None else \
+                        self._acc_add(aux_dev, aux)
+            else:
+                _, packed = self.store.next_layer()
+                x = self._jit_block_fwd_eval(packed, x, zero_key)
+        loss = float(self._jit_head_loss(self.resident, x, mb))
+        if aux_dev is not None:
+            loss += self.adapter.aux_weight * float(aux_dev)
+        return loss
 
     def full_params_tree(self):
         """The complete param pytree as host arrays (checkpoint surface;
         materializes the NVMe store)."""
-        tree = dict(self._resident_host)
-        tree["blocks"] = {"block": self.store.materialize_stacked()}
+        if self.hetero:
+            tree = self.adapter.merge(self._resident_host,
+                                      self.store.materialize_layers())
+        else:
+            tree = self.adapter.merge(self._resident_host,
+                                      self.store.materialize_stacked())
         # restore original key order via the saved treedef
         flat = _flatten_with_paths(tree)
         return jax.tree_util.tree_unflatten(
@@ -750,11 +1091,15 @@ class ParamOffloadRunner:
         sync_master_from / load_state_dict, same as the resident path)."""
         params_host = jax.tree_util.tree_map(lambda a: np.asarray(a),
                                              params_host)
-        self._resident_host = {k: v for k, v in params_host.items()
-                               if k != "blocks"}
+        self._resident_host, streamed = self.adapter.split(params_host)
         self.resident = jax.tree_util.tree_map(
             lambda a: jax.device_put(
                 a.astype(self.compute_dtype) if jnp.issubdtype(
                     a.dtype, jnp.floating) else a, self._rep),
             self._resident_host)
-        self.store.update_from_stacked(params_host["blocks"]["block"])
+        if self.hetero:
+            for i, tree in enumerate(streamed):
+                self.store.write_layer(i, tree)
+            self.store.flush_writes()
+        else:
+            self.store.update_from_stacked(streamed)

@@ -194,3 +194,51 @@ def _onebit_wire_worker(rank, world):
 
 def test_multiprocess_onebit_compressed_wire():
     run_distributed(_onebit_wire_worker, world_size=2)
+
+
+def _param_offload_worker(rank, world):
+    """offload_param streaming across REAL process boundaries (VERDICT r4
+    next-#5): per-layer grads reduce across processes via their replicated
+    out-sharding over the global mesh; every process's host Adam must stay
+    in lockstep (identical losses AND identical streamed params)."""
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer_lm import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    model = TransformerLM(TransformerConfig(
+        vocab_size=64, n_embd=32, n_layer=2, n_head=4, max_seq_len=32))
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "zero_optimization": {"offload_param": {"device": "cpu"}},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 10 ** 9})
+    assert engine.dp_world_size == world
+    rng = np.random.default_rng(0)  # same data every rank (SPMD contract)
+    batch = {"input_ids": rng.integers(
+        0, 64, (engine.train_batch_size(), 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+    from deepspeed_tpu.comm import comm as dist
+    dist.assert_same_across_ranks(
+        {"po_losses": [round(l, 5) for l in losses]}, "offload losses")
+    # the streamed param store itself must agree across processes (the
+    # host Adam runs per-process on the reduced grads)
+    import jax
+    leaves = jax.tree_util.tree_leaves(
+        engine._param_offload.store.stacked)
+    digest = float(sum(float(np.abs(np.asarray(l, np.float32)).sum())
+                       for l in leaves))
+    dist.assert_same_across_ranks({"param_digest": round(digest, 4)},
+                                  "streamed param digest")
+
+
+def test_multiprocess_param_offload():
+    run_distributed(_param_offload_worker, world_size=2)
