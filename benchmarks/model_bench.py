@@ -58,6 +58,9 @@ def main():
     ap.add_argument("--flash", default="auto",
                     choices=["auto", "on", "off"],
                     help="Pallas flash attention kernel selection")
+    ap.add_argument("--fused-ln", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="fused LayerNorm->matmul Pallas kernel (ln_linear)")
     args = ap.parse_args()
     _enable_persistent_cache()
 
@@ -70,10 +73,12 @@ def main():
 
     spec = MODELS[args.model]
     flash = {"auto": "auto", "on": True, "off": False}[args.flash]
+    fused = {"auto": "auto", "on": True, "off": False}[args.fused_ln]
     cfg = GPT2Config(vocab_size=50257, n_positions=args.seq,
                      dtype=jnp.bfloat16, remat=not args.no_remat,
                      remat_policy=args.remat_policy,
-                     use_flash_attention=flash, **spec)
+                     use_flash_attention=flash, fused_ln_linear=fused,
+                     **spec)
     config = {
         "train_micro_batch_size_per_gpu": args.mbs,
         "gradient_accumulation_steps": args.gas,
@@ -112,7 +117,7 @@ def main():
         "seq": args.seq, "mbs": args.mbs, "gas": args.gas,
         "zero_stage": args.stage, "offload": bool(args.offload),
         "remat": (args.remat_policy if not args.no_remat else "off"),
-        "flash": args.flash,
+        "flash": args.flash, "fused_ln": args.fused_ln,
         "compile_s": round(compile_s, 1),
     }
 
