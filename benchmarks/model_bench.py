@@ -54,7 +54,7 @@ def main():
     ap.add_argument("--no-remat", action="store_true",
                     help="disable activation checkpointing (fits smaller runs)")
     ap.add_argument("--remat-policy", default="dots",
-                    choices=["full", "dots"])
+                    choices=["full", "dots", "dots_plain"])
     ap.add_argument("--flash", default="auto",
                     choices=["auto", "on", "off"],
                     help="Pallas flash attention kernel selection")

@@ -62,10 +62,14 @@ class GPT2Config:
     sparse_attention: Optional[Any] = None
     # fused LayerNorm->matmul Pallas kernel for the ln_1->qkv and ln_2->fc
     # pairs (ops/transformer/ln_linear.py — the TPU analog of the
-    # reference's fused transformer-block kernel). True | False | "auto"
-    # (on-TPU only; the parameter tree is identical either way). Composes
-    # with single-program meshes; model-parallel shardings keep the
-    # declarative XLA path
+    # reference's fused transformer-block kernel). True | False | "auto".
+    # The parameter tree is identical either way. "auto" currently
+    # resolves to OFF: the round-5 flagship A/B measured the fused kernel
+    # at 0.91x XLA's composition (40.9k -> 37.3k tok/s at 350M/seq1024 —
+    # benchmarks/model_bench_results.json; XLA's matmul pipelining +
+    # multi-output fusions beat hand fusion at these shapes). Kept as an
+    # explicit option and parity-tested; does not compose with model
+    # parallelism (the Pallas call is not GSPMD-partitionable)
     fused_ln_linear: Any = "auto"
 
 
@@ -106,10 +110,11 @@ def gpt2_sharding_rules():
 
 
 def _use_fused_ln(cfg) -> bool:
-    """Fused ln->matmul gate: explicit flag, or "auto" = TPU backend with
-    no model-parallel sharding (the Pallas call is not GSPMD-partitionable;
-    TP keeps the declarative XLA path). An explicit True under TP raises —
-    silently downgrading a demanded kernel would mis-attribute benchmarks."""
+    """Fused ln->matmul gate. "auto" resolves OFF (the measured flagship
+    A/B has XLA's composition 1.10x the fused kernel — GPT2Config note);
+    explicit True demands the kernel and raises under model parallelism
+    (the Pallas call is not GSPMD-partitionable) — silently downgrading a
+    demanded kernel would mis-attribute benchmarks."""
     if cfg.fused_ln_linear is False:
         return False
     from ..parallel.mesh import get_model_parallel_world_size
@@ -121,8 +126,9 @@ def _use_fused_ln(cfg) -> bool:
                 "parallelism (the Pallas call is not GSPMD-partitionable); "
                 "use fused_ln_linear='auto' to fall back automatically")
         return True
-    return jax.default_backend() == "tpu" and \
-        get_model_parallel_world_size() == 1
+    # "auto" = off: the measured A/B has XLA's composition 1.10x faster
+    # than the fused kernel at the flagship shape (see GPT2Config note)
+    return False
 
 
 class _LNParams(nn.Module):
@@ -318,7 +324,13 @@ class _ScanBody(nn.Module):
         block_cls = Block
         if self.config.remat:
             policy = None
-            if self.config.remat_policy == "dots":
+            if self.config.remat_policy == "dots_plain":
+                # dots WITHOUT the named attention/ln saves — A/B isolation
+                # for the save-vs-recompute tradeoff (saving out/lse costs
+                # ~20 MB x n_layer of live memory at the flagship shape)
+                policy = jax.checkpoint_policies.\
+                    dots_with_no_batch_dims_saveable
+            elif self.config.remat_policy == "dots":
                 # dots policy + named attention-kernel outputs: saves matmul
                 # outputs AND the flash/sparse kernel's (out, lse), so the
                 # backward pass reuses the attention forward instead of

@@ -97,8 +97,12 @@ def _bwd_dx_kernel(dy_ref, w_ref, x_ref, g_ref, mean_ref, rstd_ref, dx_ref,
         m1 = jnp.mean(dxh, axis=1, keepdims=True)
         m2 = jnp.mean(dxh * xh, axis=1, keepdims=True)
         dx_ref[...] = (rstd * (dxh - m1 - xh * m2)).astype(dx_ref.dtype)
-        dg_ref[...] = jnp.sum(dn * xh, axis=0, keepdims=True)
-        db_ref[...] = jnp.sum(dn, axis=0, keepdims=True)
+        # per-M-tile partials, replicated across the 8-sublane dim (a
+        # (1, C) block violates Mosaic's sublane-divisibility rule)
+        dg_ref[...] = jnp.broadcast_to(
+            jnp.sum(dn * xh, axis=0, keepdims=True), dg_ref.shape)
+        db_ref[...] = jnp.broadcast_to(
+            jnp.sum(dn, axis=0, keepdims=True), db_ref.shape)
 
 
 def _pick_block(size: int, prefer: int) -> Optional[int]:
@@ -172,15 +176,15 @@ def _ln_linear_bwd_impl(x, gamma, mean, rstd, w, dy, *, block_m, block_n):
         out_specs=[
             pl.BlockSpec((block_m, c), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, c), lambda i, j: (i, 0),
+            pl.BlockSpec((SUBLANES, c), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, c), lambda i, j: (i, 0),
+            pl.BlockSpec((SUBLANES, c), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((m, c), x.dtype),
-            jax.ShapeDtypeStruct((m // block_m, c), jnp.float32),
-            jax.ShapeDtypeStruct((m // block_m, c), jnp.float32),
+            jax.ShapeDtypeStruct((m // block_m * SUBLANES, c), jnp.float32),
+            jax.ShapeDtypeStruct((m // block_m * SUBLANES, c), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((block_m, c), jnp.float32)],
         interpret=_interpret(),
@@ -210,8 +214,12 @@ def _ln_linear_vjp_bwd(eps, block_m, block_n, res, dy):
     x, gamma, beta, mean, rstd, w = res
     dx, dg_parts, db_parts = _ln_linear_bwd_impl(
         x, gamma, mean, rstd, w, dy, block_m=block_m, block_n=block_n)
-    dgamma = dg_parts.sum(0).astype(gamma.dtype)
-    dbeta = db_parts.sum(0).astype(beta.dtype)
+    # parts are replicated over the sublane dim: take row 0 of each tile
+    c = x.shape[1]
+    dgamma = dg_parts.reshape(-1, SUBLANES, c)[:, 0].sum(0).astype(
+        gamma.dtype)
+    dbeta = db_parts.reshape(-1, SUBLANES, c)[:, 0].sum(0).astype(
+        beta.dtype)
     # dW/db on XLA: recompute n elementwise from the saved stats (one
     # backward-only materialization, same cost the unfused remat pays)
     xf = x.astype(jnp.float32)
