@@ -66,12 +66,14 @@ def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(block_start < length)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # (1, D)
+        # MXU operands stay in the input dtype (bf16 at full rate on
+        # v5e); fp32 stats/accumulator; scale applied to fp32 s
+        q = q_ref[0]                                      # (1, D)
         qb = jnp.broadcast_to(q, (SUBLANES, q.shape[-1]))
-        k = k_ref[0, 0].astype(jnp.float32)               # (block_s, D)
-        v = v_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0]                                   # (block_s, D)
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(qb, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         pos = block_start + jax.lax.broadcasted_iota(
             jnp.int32, (SUBLANES, block_s), 1)
         if alibi:
@@ -87,7 +89,7 @@ def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[:] = jnp.broadcast_to(
             alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
@@ -115,6 +117,11 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     _, KV, S, _ = k_cache.shape
     assert H % KV == 0, f"H={H} not a multiple of KV={KV}"
     rep = H // KV
+    # MXU operands must share a dtype (the kernel no longer upcasts to
+    # fp32 — bf16 runs at full MXU rate); harmonize q to the cache dtype
+    # and restore the caller's dtype on the way out
+    out_dtype = q.dtype
+    q = q.astype(k_cache.dtype)
     if scale is None:
         scale = 1.0 / math.sqrt(D)
     lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (B,))
@@ -164,4 +171,4 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
         interpret=_interpret(),
     )(lengths, slopes, q3, k_cache, v_cache)
-    return out.reshape(B, H, D)
+    return out.reshape(B, H, D).astype(out_dtype)

@@ -100,3 +100,35 @@ def test_model_decode_kernel_matches_jnp_path():
     out_off = gen("off")
     out_on = gen("on")
     np.testing.assert_array_equal(out_on, out_off)
+
+
+def test_bf16_matches_reference():
+    """bf16 inputs exercise the actual production path (round 5: MXU
+    operands stay bf16 — the fp32 tests above are byte-identical to the
+    pre-change kernel, so this is the only coverage of the changed dots
+    and of the p -> bf16 downcast before the p.V dot)."""
+    B, H, KV, D, S = 2, 4, 2, 64, 256
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.bfloat16)
+    lengths = jnp.asarray([S, S // 3], jnp.int32)
+    out = decode_attention(q, k, v, lengths, block_s=64)
+    assert out.dtype == jnp.bfloat16
+    ref = _reference(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_mixed_dtype_query_is_harmonized():
+    """fp32 queries against a bf16 cache must not raise (the wrapper
+    casts q to the cache dtype and restores the caller's dtype out)."""
+    B, H, KV, D, S = 1, 2, 2, 64, 128
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.bfloat16)
+    out = decode_attention(q, k, v, jnp.asarray([S], jnp.int32), block_s=64)
+    assert out.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(out)))
