@@ -37,14 +37,14 @@ STEPS_TIMED = 8
 STEPS_WARM = 3
 
 
-def build(kind):
+def build(kind, dispatch_mode="index"):
     import jax.numpy as jnp
 
     from deepspeed_tpu.models.gpt_moe import GPTMoEConfig, GPTMoEModel
 
     kw = dict(vocab_size=32768, n_positions=T, n_embd=1024, n_layer=8,
               n_head=16, capacity_factor=1.25, drop_tokens=True,
-              dtype=jnp.bfloat16)
+              moe_dispatch_mode=dispatch_mode, dtype=jnp.bfloat16)
     if kind == "dense":
         cfg = GPTMoEConfig(moe_every=0, **kw)
     elif kind == "moe_top1":
@@ -54,12 +54,13 @@ def build(kind):
     return GPTMoEModel(cfg)
 
 
-def run(kind, steps=STEPS_WARM + STEPS_TIMED, record_aux=False):
+def run(kind, steps=STEPS_WARM + STEPS_TIMED, record_aux=False,
+        dispatch_mode="index"):
     import jax
 
     import deepspeed_tpu as ds
 
-    model = build(kind)
+    model = build(kind, dispatch_mode)
     engine, _, _, _ = ds.initialize(
         model=model,
         config={"train_micro_batch_size_per_gpu": B,
@@ -97,6 +98,7 @@ def run(kind, steps=STEPS_WARM + STEPS_TIMED, record_aux=False):
     med = float(np.median(timed))
     return {
         "kind": kind,
+        "dispatch_mode": dispatch_mode if kind != "dense" else None,
         "params_m": round(n_params / 1e6, 1),
         "median_step_s": round(med, 4),
         "tokens_per_s": round(B * T / med, 1),
@@ -175,15 +177,24 @@ def main():
         with open(out_path, "w") as f:
             json.dump(result, f, indent=1)
 
-    for kind in ("dense", "moe_top1", "moe_top2"):
-        result["rows"].append(run(kind))
+    # A/B the two dispatch materializations at the same routing decisions:
+    # "einsum" = the reference's dense one-hot form, "index" = the
+    # TPU-native scatter/gather default (moe/sharded_moe.py module doc)
+    for kind, mode in (("dense", "index"),
+                       ("moe_top1", "einsum"), ("moe_top1", "index"),
+                       ("moe_top2", "einsum"), ("moe_top2", "index")):
+        result["rows"].append(run(kind, dispatch_mode=mode))
         print(f"[moe_bench] row done: {result['rows'][-1]}", flush=True)
         flush()  # partial results survive tunnel outages
     rows = result["rows"]
-    dense_t = rows[0]["median_step_s"]
-    moe1_t = rows[1]["median_step_s"]
+    by = {(r["kind"], r["dispatch_mode"]): r["median_step_s"] for r in rows}
+    dense_t = by[("dense", None)]
+    moe1_t = by[("moe_top1", "index")]
     overhead_pct = 100.0 * (moe1_t - dense_t) / dense_t
     result["gating_dispatch_overhead_pct"] = round(overhead_pct, 1)
+    result["index_vs_einsum_speedup"] = {
+        k: round(by[(k, "einsum")] / by[(k, "index")], 3)
+        for k in ("moe_top1", "moe_top2")}
     flush()
     try:
         aux_traj, shares = expert_balance()

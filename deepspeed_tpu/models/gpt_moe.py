@@ -43,6 +43,9 @@ class GPTMoEConfig:
     # use_rts, sharded_moe.py: breaks position bias; draws the "gating"
     # rng in train mode). False = deterministic position-order dropping
     use_rts: bool = True
+    # "index" (scatter/gather, TPU-native default) or "einsum" (the
+    # reference's dense one-hot dispatch) — see moe/sharded_moe.py
+    moe_dispatch_mode: str = "index"
     aux_loss_weight: float = 0.01
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-5
@@ -80,6 +83,7 @@ class _Block(nn.Module):
                 hidden_size=cfg.n_embd, num_experts=self.num_experts,
                 k=cfg.k, capacity_factor=cfg.capacity_factor,
                 drop_tokens=cfg.drop_tokens, use_rts=cfg.use_rts,
+                dispatch_mode=cfg.moe_dispatch_mode,
                 name="moe")(
                     ln2(x), deterministic=deterministic)
             x = x + moe_out

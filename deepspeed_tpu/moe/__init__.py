@@ -1,7 +1,12 @@
 from .layer import ExpertMLP, MoE, moe_sharding_rules  # noqa: F401
 from .sharded_moe import (  # noqa: F401
+    GateDecisions,
+    combine_indexed,
     combine_output,
+    dispatch_indexed,
+    expert_counts,
     gate_and_dispatch,
+    gate_decisions,
     top1gating,
     top2gating,
 )

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_mod
-from .sharded_moe import combine_output, gate_and_dispatch
+from .sharded_moe import (combine_indexed, combine_output, dispatch_indexed,
+                          expert_counts, gate_and_dispatch, gate_decisions)
 
 
 def moe_sharding_rules(prefix: str = ""):
@@ -68,6 +69,10 @@ class MoE(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
+    # "index" (default): scatter/gather dispatch, O(S·M) — no (S,E,C)
+    # tensor, no S·E·C·M einsum. "einsum": the reference's dense one-hot
+    # form. Routing is identical (both consume the same GateDecisions).
+    dispatch_mode: str = "index"
     expert_cls: Type[nn.Module] = ExpertMLP
     expert_kwargs: Optional[dict] = None
     dtype: Any = jnp.float32
@@ -82,14 +87,30 @@ class MoE(nn.Module):
         gate_logits = nn.Dense(self.num_experts, use_bias=False, name="gate",
                                dtype=jnp.float32)(tokens.astype(jnp.float32))
 
+        if self.dispatch_mode not in ("index", "einsum"):
+            raise ValueError(f"dispatch_mode must be 'index' or 'einsum', "
+                             f"got {self.dispatch_mode!r}")
         rng = self.make_rng("gating") if self.has_rng("gating") else None
         cap_factor = self.capacity_factor if not deterministic \
             else self.eval_capacity_factor
-        aux_loss, dispatched, combine = gate_and_dispatch(
-            tokens, gate_logits, k=self.k, capacity_factor=cap_factor,
-            min_capacity=self.min_capacity,
-            noisy_gate_policy=self.noisy_gate_policy if not deterministic else None,
-            drop_tokens=self.drop_tokens, use_rts=self.use_rts, rng=rng)
+        if self.dispatch_mode == "index":
+            dec = gate_decisions(
+                gate_logits, k=self.k, capacity_factor=cap_factor,
+                min_capacity=self.min_capacity,
+                noisy_gate_policy=(self.noisy_gate_policy
+                                   if not deterministic else None),
+                drop_tokens=self.drop_tokens, use_rts=self.use_rts, rng=rng)
+            aux_loss = dec.aux_loss
+            dispatched = dispatch_indexed(tokens, dec, self.num_experts)
+            combine = None
+        else:
+            dec = None
+            aux_loss, dispatched, combine = gate_and_dispatch(
+                tokens, gate_logits, k=self.k, capacity_factor=cap_factor,
+                min_capacity=self.min_capacity,
+                noisy_gate_policy=(self.noisy_gate_policy
+                                   if not deterministic else None),
+                drop_tokens=self.drop_tokens, use_rts=self.use_rts, rng=rng)
 
         # Move expert dim onto the expert axis: XLA emits the all-to-all here
         # (≅ reference _AllToAll before expert compute, sharded_moe.py:90)
@@ -113,7 +134,10 @@ class MoE(nn.Module):
         # all-to-all back before combine
         expert_out = jax.lax.with_sharding_constraint(
             expert_out, NamedSharding(mesh, P(mesh_mod.EXPERT_AXIS, None, None)))
-        out = combine_output(expert_out, combine)
-
-        exp_counts = jnp.sum(combine > 0, axis=(0, 2))  # tokens per expert
+        if self.dispatch_mode == "index":
+            out = combine_indexed(expert_out, dec)
+            exp_counts = expert_counts(dec, self.num_experts)
+        else:
+            out = combine_output(expert_out, combine)
+            exp_counts = jnp.sum(combine > 0, axis=(0, 2))  # tokens per expert
         return out.reshape(orig_shape).astype(x.dtype), aux_loss, exp_counts

@@ -13,15 +13,45 @@ autograd Function. Here the dispatched tensor gets a *sharding constraint*
 engine.py:2304 expert-grad groups) also falls out declaratively: expert
 params are sharded over the ``expert`` axis, so their grads reduce only over
 the remaining (data, seq) axes.
+
+Two dispatch materializations share one gating core (:class:`GateDecisions`):
+
+* ``einsum`` — the reference's dense one-hot form,
+  ``einsum("sec,sm->ecm")`` (sharded_moe.py:420). Costs S·E·C·M MACs each
+  way and materializes the (S,E,C) combine tensor; at NLG-recipe shapes
+  (S=16k, E=8, cf=1.25 top-2) that is ~2.5x the expert FFN FLOPs and a
+  multi-GB intermediate.
+* ``index`` (default) — TPU-native scatter/gather: tokens are scattered
+  into their (expert, slot) rows and gathered back with gate weights,
+  O(S·M) memory traffic and no (S,E,C) tensor. Both paths consume the SAME
+  decisions, so routing is identical by construction (parity-tested in
+  ``tests/unit/moe/test_moe.py``).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+class GateDecisions(NamedTuple):
+    """Routing decisions for a batch of S tokens under top-k gating.
+
+    ``expert_idx``/``slot``/``gate``/``valid`` are (S, k): for each token
+    and choice j, the expert it routes to, its slot in that expert's
+    capacity buffer, its (top-2: renormalized) combine weight, and whether
+    it survived the capacity cut. ``aux_loss`` is the load-balance loss
+    (computed pre-capacity, as the reference does)."""
+
+    aux_loss: jnp.ndarray
+    expert_idx: jnp.ndarray   # (S, k) int32
+    slot: jnp.ndarray         # (S, k) int32
+    gate: jnp.ndarray         # (S, k) float32
+    valid: jnp.ndarray        # (S, k) bool
+    capacity: int
 
 
 def _one_hot(x, num_classes):
@@ -43,17 +73,15 @@ def _gumbel_noise(rng, shape):
     return jax.random.gumbel(rng, shape)
 
 
-def top1gating(logits: jnp.ndarray,
-               capacity_factor: float = 1.0,
-               min_capacity: int = 4,
-               noisy_gate_policy: Optional[str] = None,
-               drop_tokens: bool = True,
-               use_rts: bool = True,
-               rng: Optional[jax.Array] = None,
-               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
-    """Top-1 gating (≅ reference sharded_moe.py:179).
+def top1_decisions(logits: jnp.ndarray,
+                   capacity_factor: float = 1.0,
+                   min_capacity: int = 4,
+                   noisy_gate_policy: Optional[str] = None,
+                   drop_tokens: bool = True,
+                   use_rts: bool = True,
+                   rng: Optional[jax.Array] = None) -> GateDecisions:
+    """Top-1 routing decisions (≅ reference sharded_moe.py:179).
 
-    Returns (aux_loss, combine_weights (S,E,C), dispatch_mask (S,E,C), capacity).
     Random token selection (``use_rts``) breaks position bias when dropping.
     """
     S, E = logits.shape
@@ -90,23 +118,26 @@ def top1gating(logits: jnp.ndarray,
     locations1 = jnp.sum(locations_sorted[inv] * mask1, axis=1)  # (S,)
 
     keep = (locations1 < capacity) & (jnp.sum(mask1, axis=1) > 0)
-    mask1 = mask1 * keep[:, None]
+    gates1 = jnp.sum(gates * mask1, axis=1)  # gate value of chosen expert
 
-    gates1 = jnp.sum(gates * mask1, axis=1)  # gate value of kept tokens
-    loc_oh = _one_hot(locations1.astype(jnp.int32), capacity)  # (S, C)
-    combine = gates1[:, None, None] * mask1[:, :, None] * loc_oh[:, None, :]
-    dispatch = combine > 0
-    return aux_loss, combine.astype(logits.dtype), dispatch, capacity
+    return GateDecisions(
+        aux_loss=aux_loss,
+        expert_idx=indices1.astype(jnp.int32)[:, None],
+        slot=locations1.astype(jnp.int32)[:, None],
+        gate=gates1[:, None],
+        valid=keep[:, None],
+        capacity=capacity)
 
 
-def top2gating(logits: jnp.ndarray,
-               capacity_factor: float = 1.0,
-               min_capacity: int = 4,
-               drop_tokens: bool = True,
-               rng: Optional[jax.Array] = None,
-               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
-    """Top-2 gating (≅ reference sharded_moe.py:277): second expert chosen
-    with gumbel noise, gates renormalized over the two picks."""
+def top2_decisions(logits: jnp.ndarray,
+                   capacity_factor: float = 1.0,
+                   min_capacity: int = 4,
+                   drop_tokens: bool = True,
+                   rng: Optional[jax.Array] = None) -> GateDecisions:
+    """Top-2 routing decisions (≅ reference sharded_moe.py:277): second
+    expert chosen with gumbel noise, gates renormalized over the two picks
+    (after the capacity cut, so a dropped first choice passes full weight
+    to the surviving second — the reference's order of operations)."""
     S, E = logits.shape
     capacity = _capacity(S, E, 2 * capacity_factor, min_capacity)
     if not drop_tokens:
@@ -135,21 +166,126 @@ def top2gating(logits: jnp.ndarray,
 
     loc1 = jnp.sum(locations1 * mask1, axis=1)
     loc2 = jnp.sum(locations2 * mask2, axis=1)
-    mask1 = mask1 * (loc1 < capacity)[:, None]
-    mask2 = mask2 * (loc2 < capacity)[:, None]
+    valid1 = loc1 < capacity
+    valid2 = loc2 < capacity
 
-    gates1 = jnp.sum(gates * mask1, axis=1)
-    gates2 = jnp.sum(gates * mask2, axis=1)
+    gates1 = jnp.sum(gates * mask1, axis=1) * valid1
+    gates2 = jnp.sum(gates * mask2, axis=1) * valid2
     denom = jnp.maximum(gates1 + gates2, jnp.finfo(gates.dtype).eps)
     gates1, gates2 = gates1 / denom, gates2 / denom
 
-    loc1_oh = _one_hot(loc1.astype(jnp.int32), capacity)
-    loc2_oh = _one_hot(loc2.astype(jnp.int32), capacity)
-    combine1 = gates1[:, None, None] * mask1[:, :, None] * loc1_oh[:, None, :]
-    combine2 = gates2[:, None, None] * mask2[:, :, None] * loc2_oh[:, None, :]
-    combine = combine1 + combine2
+    return GateDecisions(
+        aux_loss=aux_loss,
+        expert_idx=jnp.stack([indices1, indices2], axis=1).astype(jnp.int32),
+        slot=jnp.stack([loc1, loc2], axis=1).astype(jnp.int32),
+        gate=jnp.stack([gates1, gates2], axis=1),
+        valid=jnp.stack([valid1, valid2], axis=1),
+        capacity=capacity)
+
+
+def gate_decisions(logits: jnp.ndarray, k: int = 1,
+                   capacity_factor: float = 1.0, min_capacity: int = 4,
+                   noisy_gate_policy: Optional[str] = None,
+                   drop_tokens: bool = True, use_rts: bool = True,
+                   rng: Optional[jax.Array] = None) -> GateDecisions:
+    """Top-k routing decisions (dispatcher over top1/top2)."""
+    if k == 1:
+        return top1_decisions(logits, capacity_factor, min_capacity,
+                              noisy_gate_policy, drop_tokens, use_rts, rng)
+    if k == 2:
+        return top2_decisions(logits, capacity_factor, min_capacity,
+                              drop_tokens, rng)
+    raise ValueError(f"top-{k} gating unsupported (reference supports k=1,2)")
+
+
+def _densify(dec: GateDecisions, num_experts: int, dtype
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Decisions → dense (combine (S,E,C), dispatch (S,E,C)) one-hot form."""
+    S, k = dec.expert_idx.shape
+    combine = jnp.zeros((S, num_experts, dec.capacity), jnp.float32)
+    for j in range(k):
+        maskj = _one_hot(dec.expert_idx[:, j], num_experts) \
+            * dec.valid[:, j].astype(jnp.float32)[:, None]
+        loc_oh = _one_hot(dec.slot[:, j], dec.capacity)
+        combine = combine + (dec.gate[:, j][:, None, None]
+                             * maskj[:, :, None] * loc_oh[:, None, :])
     dispatch = combine > 0
-    return aux_loss, combine.astype(logits.dtype), dispatch, capacity
+    return combine.astype(dtype), dispatch
+
+
+def top1gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True,
+               rng: Optional[jax.Array] = None,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
+    """Top-1 gating, dense form (≅ reference sharded_moe.py:179).
+
+    Returns (aux_loss, combine_weights (S,E,C), dispatch_mask (S,E,C), capacity).
+    """
+    dec = top1_decisions(logits, capacity_factor, min_capacity,
+                         noisy_gate_policy, drop_tokens, use_rts, rng)
+    combine, dispatch = _densify(dec, logits.shape[1], logits.dtype)
+    return dec.aux_loss, combine, dispatch, dec.capacity
+
+
+def top2gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               drop_tokens: bool = True,
+               rng: Optional[jax.Array] = None,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
+    """Top-2 gating, dense form (≅ reference sharded_moe.py:277)."""
+    dec = top2_decisions(logits, capacity_factor, min_capacity,
+                         drop_tokens, rng)
+    combine, dispatch = _densify(dec, logits.shape[1], logits.dtype)
+    return dec.aux_loss, combine, dispatch, dec.capacity
+
+
+def dispatch_indexed(tokens: jnp.ndarray, dec: GateDecisions,
+                     num_experts: int) -> jnp.ndarray:
+    """tokens (S, M) → dispatched (E, C, M) by scatter-add into (expert,
+    slot) rows. O(S·M) memory traffic; replaces the S·E·C·M dispatch
+    einsum (reference sharded_moe.py:420). Invalid/zero-gate tokens land
+    in a pad row that is sliced off (mirrors ``dispatch = combine > 0``)."""
+    S, M = tokens.shape
+    E, C = num_experts, dec.capacity
+    flat = jnp.zeros((E * C + 1, M), tokens.dtype)
+    for j in range(dec.expert_idx.shape[1]):
+        p = dec.expert_idx[:, j] * C + dec.slot[:, j]
+        keep = dec.valid[:, j] & (dec.gate[:, j] > 0)
+        p = jnp.where(keep, p, E * C)
+        flat = flat.at[p].add(tokens)
+    return flat[:E * C].reshape(E, C, M)
+
+
+def combine_indexed(expert_out: jnp.ndarray, dec: GateDecisions) -> jnp.ndarray:
+    """expert outputs (E, C, M) → (S, M) by gathering each token's
+    (expert, slot) row(s) and weighting by its gate (reference's combine
+    einsum, sharded_moe.py:472, without the (S,E,C) tensor)."""
+    E, C, M = expert_out.shape
+    flat = expert_out.reshape(E * C, M)
+    S = dec.expert_idx.shape[0]
+    out = jnp.zeros((S, M), expert_out.dtype)
+    for j in range(dec.expert_idx.shape[1]):
+        p = jnp.where(dec.valid[:, j],
+                      dec.expert_idx[:, j] * C + dec.slot[:, j], 0)
+        w = (dec.gate[:, j] * dec.valid[:, j]).astype(expert_out.dtype)
+        out = out + w[:, None] * flat[p]
+    return out
+
+
+def expert_counts(dec: GateDecisions, num_experts: int) -> jnp.ndarray:
+    """Tokens dispatched per expert (the reference's ``exp_counts``)."""
+    counts = jnp.zeros((num_experts,), jnp.int32)
+    for j in range(dec.expert_idx.shape[1]):
+        keep = dec.valid[:, j] & (dec.gate[:, j] > 0)
+        counts = counts + jnp.sum(
+            _one_hot(dec.expert_idx[:, j], num_experts)
+            * keep.astype(jnp.float32)[:, None], axis=0).astype(jnp.int32)
+    return counts
 
 
 def gate_and_dispatch(tokens: jnp.ndarray, gate_logits: jnp.ndarray, k: int = 1,
@@ -159,7 +295,9 @@ def gate_and_dispatch(tokens: jnp.ndarray, gate_logits: jnp.ndarray, k: int = 1,
                       rng: Optional[jax.Array] = None):
     """tokens (S, M) + logits (S, E) → (aux_loss, dispatched (E, C, M),
     combine (S, E, C)). The dispatch einsum is the reference's
-    ``einsum("sec,sm->ecm")`` (sharded_moe.py:420 area)."""
+    ``einsum("sec,sm->ecm")`` (sharded_moe.py:420 area). Dense form; the
+    MoE layer's default is the indexed form (``gate_decisions`` +
+    ``dispatch_indexed``/``combine_indexed``)."""
     if k == 1:
         aux, combine, dispatch, _ = top1gating(
             gate_logits, capacity_factor, min_capacity, noisy_gate_policy,

@@ -27,11 +27,18 @@ scales ≈ N/4 — a ~32x reduction, matching the shape of the reference's
 packed compression-phase claim (nccl.py:54-130).
 
 Scope (mirrors the reference's own constraints for 1-bit optimizers):
-pure data parallelism (mp = sp = pp = 1), ZeRO stage 0 (replicated fp32
-master) or stage 1 — stage 1 shards v + the fp32 master over the data
-axis as ``onebit["v"]``/``onebit["master_flat"]`` rows and re-gathers
-bf16 params each step (no replicated master exists); bf16 compute (no
-dynamic loss scale), no gradient clipping in the compression stage.
+data parallelism, optionally composed with tensor parallelism (the
+reference's OneBitAdam runs under Megatron TP) — the exchange is manual
+over the ``data`` mesh axis only (``shard_map(..., axis_names={data})``),
+so the ``model`` axis stays a GSPMD *auto* axis: the model's own TP
+sharding constraints keep working inside the step, TP-sharded gradients
+stay sharded, and the packed collectives over ``data`` run independently
+per model rank (each moves its shard of the wire). sp = pp = 1; ZeRO
+stage 0 (replicated fp32 master) or stage 1 — stage 1 shards v + the
+fp32 master over the data axis as ``onebit["v"]``/``onebit["master_flat"]``
+rows and re-gathers bf16 params each step (no replicated master exists);
+bf16 compute (no dynamic loss scale), no gradient clipping in the
+compression stage.
 """
 
 from __future__ import annotations
@@ -54,6 +61,14 @@ from ...comm.compressed import compressed_allreduce
 LANES = 128
 
 
+def _supports_auto_axes() -> bool:
+    """jax >= 0.9 shard_map takes ``axis_names`` (the set of MANUAL axes;
+    every other mesh axis stays GSPMD-auto) — what lets the exchange be
+    manual over ``data`` while TP sharding constraints keep working."""
+    import inspect
+    return "axis_names" in inspect.signature(shard_map).parameters
+
+
 def is_enabled(config, mesh) -> bool:
     """comm_backend_name="compressed" in the optimizer params activates the
     wire path (reference config surface: onebit optimizers take
@@ -69,10 +84,14 @@ def is_enabled(config, mesh) -> bool:
 
 
 def check_supported(engine) -> None:
-    if engine.mp_world_size != 1 or \
-            mesh_mod.get_sequence_parallel_world_size() > 1:
-        raise ValueError("comm_backend_name=compressed supports pure data "
-                         "parallelism only (mp=sp=1)")
+    if mesh_mod.get_sequence_parallel_world_size() > 1:
+        raise ValueError("comm_backend_name=compressed does not compose "
+                         "with sequence parallelism (sp=1); dp x tp only")
+    if engine.mp_world_size != 1 and not _supports_auto_axes():
+        raise ValueError("comm_backend_name=compressed with model "
+                         "parallelism needs jax.shard_map axis_names "
+                         "support (jax >= 0.9); this jax is older — "
+                         "run with mp=1")
     if engine.dp_world_size < 2:
         raise ValueError("comm_backend_name=compressed needs dp_world > 1 "
                          "(single rank has no wire to compress)")
@@ -337,6 +356,16 @@ def build_train_step(engine):
     def spec_like(tree, spec):
         return jax.tree_util.tree_map(lambda _: spec, tree)
 
+    # TP composition: pin the updated params (and stage-0 master) back to
+    # their engine shardings — the flat unravel would otherwise let GSPMD
+    # re-lay them out (e.g. replicate TP shards) on the next step
+    param_shardings = jax.tree_util.tree_map(
+        lambda a: a.sharding, engine.state["params"])
+    master_shardings = None
+    if engine.state.get("master") is not None:
+        master_shardings = jax.tree_util.tree_map(
+            lambda a: a.sharding, engine.state["master"])
+
     def train_batch(state, stacked_batch):
         state = dict(state)
         onebit = state.pop("onebit")
@@ -354,15 +383,24 @@ def build_train_step(engine):
         # jax >= 0.8 renamed check_rep → check_vma; disable either way (the
         # replicated outputs are made identical by the exchange itself)
         import inspect
-        kw = {"check_vma": False} \
-            if "check_vma" in inspect.signature(shard_map).parameters \
+        sig = inspect.signature(shard_map).parameters
+        kw = {"check_vma": False} if "check_vma" in sig \
             else {"check_rep": False}
+        if "axis_names" in sig:
+            # manual over data only; model (TP) stays a GSPMD auto axis
+            kw["axis_names"] = frozenset({axis})
         fn = shard_map(
             local_step, mesh=mesh,
             in_specs=(state_specs, onebit_specs, bspecs),
             out_specs=(state_specs, onebit_specs, metric_specs), **kw)
         new_state, new_onebit, metrics = fn(state, onebit, stacked_batch)
         new_state["onebit"] = new_onebit
+        new_state["params"] = jax.lax.with_sharding_constraint(
+            new_state["params"], param_shardings)
+        if master_shardings is not None and \
+                new_state.get("master") is not None:
+            new_state["master"] = jax.lax.with_sharding_constraint(
+                new_state["master"], master_shardings)
         return new_state, metrics
 
     return jax.jit(train_batch, donate_argnums=(0,))

@@ -8,7 +8,9 @@ import pytest
 
 import deepspeed_tpu as ds
 from deepspeed_tpu.moe import MoE, moe_sharding_rules, top1gating, top2gating
-from deepspeed_tpu.moe.sharded_moe import combine_output, gate_and_dispatch
+from deepspeed_tpu.moe.sharded_moe import (combine_indexed, combine_output,
+                                           dispatch_indexed, expert_counts,
+                                           gate_and_dispatch, gate_decisions)
 from deepspeed_tpu.parallel import initialize_mesh
 from deepspeed_tpu.runtime.zero.policy import ShardingRules
 from tests.unit.simple_model import base_config
@@ -55,6 +57,75 @@ def test_dispatch_combine_roundtrip_identity_experts():
     # top-2 combine weights sum to 1 → reconstruction equals original tokens
     np.testing.assert_allclose(np.asarray(out), np.asarray(tokens), rtol=1e-4,
                                atol=1e-5)
+
+
+@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("drop", [True, False])
+def test_indexed_dispatch_matches_einsum(k, drop):
+    """Index (scatter/gather) dispatch == dense einsum dispatch, fwd + bwd,
+    from the SAME routing decisions."""
+    E, S, M = 4, 64, 16
+    tokens = jax.random.normal(jax.random.PRNGKey(0), (S, M))
+    logits = jax.random.normal(jax.random.PRNGKey(1), (S, E))
+    dec = gate_decisions(logits, k=k, capacity_factor=1.0, drop_tokens=drop)
+
+    from deepspeed_tpu.moe.sharded_moe import _densify
+
+    def einsum_path(t):
+        combine, dispatch = _densify(dec, E, t.dtype)
+        dispatched = jnp.einsum("sec,sm->ecm", dispatch.astype(t.dtype), t)
+        # "experts": a fixed elementwise transform so output depends on
+        # routing but not extra params
+        return combine_output(dispatched * 2.0 + 1.0, combine)
+
+    def index_path(t):
+        dispatched = dispatch_indexed(t, dec, E)
+        return combine_indexed(dispatched * 2.0 + 1.0, dec)
+
+    out_e = einsum_path(tokens)
+    out_i = index_path(tokens)
+    np.testing.assert_allclose(np.asarray(out_i), np.asarray(out_e),
+                               rtol=1e-5, atol=1e-5)
+
+    g_e = jax.grad(lambda t: jnp.sum(jnp.sin(einsum_path(t))))(tokens)
+    g_i = jax.grad(lambda t: jnp.sum(jnp.sin(index_path(t))))(tokens)
+    np.testing.assert_allclose(np.asarray(g_i), np.asarray(g_e),
+                               rtol=1e-5, atol=1e-5)
+
+    # exp_counts parity with the dense dispatch mask
+    combine, dispatch = _densify(dec, E, tokens.dtype)
+    np.testing.assert_array_equal(
+        np.asarray(expert_counts(dec, E)),
+        np.asarray(jnp.sum(dispatch, axis=(0, 2))))
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_moe_layer_dispatch_modes_agree(k):
+    """Full MoE layer: dispatch_mode='index' == 'einsum' (same params/rng)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 16))
+
+    def build(mode):
+        return MoE(hidden_size=16, num_experts=4, k=k, capacity_factor=2.0,
+                   drop_tokens=True, dispatch_mode=mode)
+
+    params = build("einsum").init(jax.random.PRNGKey(1), x)
+    out_e, aux_e, cnt_e = build("einsum").apply(params, x)
+    out_i, aux_i, cnt_i = build("index").apply(params, x)
+    np.testing.assert_allclose(np.asarray(out_i), np.asarray(out_e),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_i), float(aux_e), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(cnt_i), np.asarray(cnt_e))
+
+    def loss(p, mode):
+        out, aux, _ = build(mode).apply(p, x)
+        return jnp.sum(out ** 2) + aux
+
+    g_e = jax.grad(loss)(params, "einsum")
+    g_i = jax.grad(loss)(params, "index")
+    for a, b in zip(jax.tree_util.tree_leaves(g_e),
+                    jax.tree_util.tree_leaves(g_i)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
 
 
 class MoEModel(nn.Module):
