@@ -204,7 +204,8 @@ def main():
         result["balance_error"] = str(e)[:200]
     flush()
     for r in rows:
-        print(f"[moe_bench] {r['kind']}: {r['params_m']}M params, "
+        mode = f" [{r['dispatch_mode']}]" if r["dispatch_mode"] else ""
+        print(f"[moe_bench] {r['kind']}{mode}: {r['params_m']}M params, "
               f"{r['tokens_per_s']} tok/s (step {r['median_step_s']}s)",
               flush=True)
     print(f"[moe_bench] gating+dispatch overhead (top1 vs FLOP-matched "

@@ -2,10 +2,14 @@
 
 Capability parity with reference ``deepspeed/autotuning/tuner/`` —
 ``GridSearchTuner`` / ``RandomTuner`` (random_tuner.py) /
-``ModelBasedTuner`` (model_based_tuner.py with its xgboost cost model;
-xgboost is not in the TPU image, so the cost model is a least-squares
-quadratic over the numeric experiment features — same role: rank untried
-points by predicted metric and explore best-first).
+``ModelBasedTuner`` (model_based_tuner.py with its xgboost cost model,
+cost_model.py:12). xgboost is not in the TPU image, so the cost model is
+a from-scratch gradient-boosted regression-tree ensemble (numpy, squared
+loss, shrinkage, depth-limited greedy splits — the same learner family
+as the reference's XGBRegressor, minus its regularization frills), with
+a least-squares quadratic fallback while there are too few observations
+to grow trees. Same role either way: rank untried points by predicted
+metric and explore best-first.
 """
 
 from __future__ import annotations
@@ -73,14 +77,72 @@ def _features(exp: Experiment) -> List[float]:
     return feats
 
 
-class CostModel:
-    """Least-squares quadratic surrogate over experiment features —
-    stands in for the reference's xgboost cost model."""
+class _RegressionTree:
+    """Depth-limited CART regression tree (greedy SSE splits)."""
 
-    def __init__(self):
-        self._X: List[List[float]] = []
-        self._y: List[float] = []
-        self._w = None
+    def __init__(self, max_depth: int = 3, min_leaf: int = 2):
+        self.max_depth = max_depth
+        self.min_leaf = min_leaf
+        self._root = None
+
+    def _build(self, X: np.ndarray, y: np.ndarray, depth: int):
+        n = len(y)
+        leaf = float(y.mean()) if n else 0.0
+        if depth >= self.max_depth or n < 2 * self.min_leaf:
+            return leaf
+        base_sse = float(((y - y.mean()) ** 2).sum())
+        best = None  # (gain, feature, threshold, mask)
+        for j in range(X.shape[1]):
+            col = X[:, j]
+            for t in np.unique(col)[:-1]:
+                mask = col <= t
+                nl = int(mask.sum())
+                if nl < self.min_leaf or n - nl < self.min_leaf:
+                    continue
+                yl, yr = y[mask], y[~mask]
+                sse = float(((yl - yl.mean()) ** 2).sum()
+                            + ((yr - yr.mean()) ** 2).sum())
+                gain = base_sse - sse
+                if best is None or gain > best[0]:
+                    best = (gain, j, float(t), mask)
+        if best is None or best[0] <= 1e-12:
+            return leaf
+        _, j, t, mask = best
+        return (j, t,
+                self._build(X[mask], y[mask], depth + 1),
+                self._build(X[~mask], y[~mask], depth + 1))
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> "_RegressionTree":
+        self._root = self._build(X, y, 0)
+        return self
+
+    def predict_one(self, x: np.ndarray) -> float:
+        node = self._root
+        while isinstance(node, tuple):
+            j, t, left, right = node
+            node = left if x[j] <= t else right
+        return node
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray([self.predict_one(x) for x in X])
+
+
+class CostModel:
+    """Gradient-boosted regression trees over experiment features — the
+    reference's xgboost surrogate (autotuning/tuner/cost_model.py:12),
+    implemented from scratch: squared-loss boosting with shrinkage.
+    Falls back to a least-squares quadratic below ``min_tree_samples``
+    observations (trees need data to split on)."""
+
+    def __init__(self, n_trees: int = 50, learning_rate: float = 0.3,
+                 max_depth: int = 3, min_tree_samples: int = 6):
+        self.n_trees = n_trees
+        self.learning_rate = learning_rate
+        self.max_depth = max_depth
+        self.min_tree_samples = min_tree_samples
+        self._base = 0.0
+        self._trees: List[_RegressionTree] = []
+        self._w = None  # quadratic fallback weights
 
     @staticmethod
     def _expand(f: List[float]) -> List[float]:
@@ -89,15 +151,36 @@ class CostModel:
         return out
 
     def fit(self, X: List[List[float]], y: List[float]) -> None:
-        self._X, self._y = X, y
-        if len(X) >= 3:
+        self._trees, self._w = [], None
+        if len(X) < 3:
+            return
+        Xa = np.asarray(X, np.float64)
+        ya = np.asarray(y, np.float64)
+        if len(X) < self.min_tree_samples:
             A = np.asarray([self._expand(f) for f in X])
-            self._w, *_ = np.linalg.lstsq(A, np.asarray(y), rcond=None)
+            self._w, *_ = np.linalg.lstsq(A, ya, rcond=None)
+            return
+        self._base = float(ya.mean())
+        pred = np.full(len(ya), self._base)
+        for _ in range(self.n_trees):
+            resid = ya - pred
+            if float((resid ** 2).mean()) < 1e-12:
+                break
+            tree = _RegressionTree(self.max_depth).fit(Xa, resid)
+            step = tree.predict(Xa)
+            if not np.any(step):
+                break
+            pred = pred + self.learning_rate * step
+            self._trees.append(tree)
 
     def predict(self, f: List[float]) -> float:
-        if self._w is None:
-            return 0.0
-        return float(np.dot(self._expand(f), self._w))
+        if self._trees:
+            x = np.asarray(f, np.float64)
+            return self._base + self.learning_rate * sum(
+                t.predict_one(x) for t in self._trees)
+        if self._w is not None:
+            return float(np.dot(self._expand(f), self._w))
+        return 0.0
 
 
 class ModelBasedTuner(BaseTuner):

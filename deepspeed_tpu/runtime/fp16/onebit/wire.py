@@ -383,10 +383,10 @@ def build_train_step(engine):
         # jax >= 0.8 renamed check_rep → check_vma; disable either way (the
         # replicated outputs are made identical by the exchange itself)
         import inspect
-        sig = inspect.signature(shard_map).parameters
-        kw = {"check_vma": False} if "check_vma" in sig \
+        kw = {"check_vma": False} \
+            if "check_vma" in inspect.signature(shard_map).parameters \
             else {"check_rep": False}
-        if "axis_names" in sig:
+        if _supports_auto_axes():
             # manual over data only; model (TP) stays a GSPMD auto axis
             kw["axis_names"] = frozenset({axis})
         fn = shard_map(

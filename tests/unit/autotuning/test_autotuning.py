@@ -74,6 +74,36 @@ class TestTuners:
         preds = [cm.predict([float(i), 1.0, 0.0]) for i in range(8)]
         assert int(np.argmax(preds)) == 4
 
+    def test_cost_model_boosted_trees_fit_nonsmooth_interaction(self):
+        """The GBDT surrogate must rank a cliff + interaction surface a
+        quadratic cannot represent (e.g. OOM cliff at mbs>8 composed with
+        a zero-stage interaction)."""
+        grid = [(float(m), float(s)) for m in range(1, 13) for s in (0., 2.)]
+
+        def truth(m, s):
+            if m > 8:            # OOM cliff
+                return -100.0
+            return m * (2.0 if s == 2.0 else 1.0)  # stage-2 doubles gain
+
+        X = [[m, 1.0, s] for m, s in grid]
+        y = [truth(m, s) for m, s in grid]
+        cm = CostModel()
+        cm.fit(X, y)
+        preds = {(m, s): cm.predict([m, 1.0, s]) for m, s in grid}
+        best = max(preds, key=preds.get)
+        assert best == (8.0, 2.0), best
+        # the cliff must be learned: any mbs>8 predicts far below the best
+        assert all(preds[(m, s)] < preds[(8.0, 2.0)] - 50
+                   for m, s in grid if m > 8)
+        assert cm._trees, "expected the boosted-tree path, not the fallback"
+
+    def test_cost_model_quadratic_fallback_small_sample(self):
+        cm = CostModel()
+        X = [[float(i), 1.0, 0.0] for i in range(4)]  # < min_tree_samples
+        cm.fit(X, [float(2 * i) for i in range(4)])
+        assert not cm._trees and cm._w is not None
+        assert abs(cm.predict([5.0, 1.0, 0.0]) - 10.0) < 1e-6
+
 
 class TestAutotunerInProcess:
     def _factories(self):
