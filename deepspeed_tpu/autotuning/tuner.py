@@ -141,6 +141,7 @@ class CostModel:
         self.max_depth = max_depth
         self.min_tree_samples = min_tree_samples
         self._base = 0.0
+        self._boosted = False  # tree path fitted (possibly with 0 trees)
         self._trees: List[_RegressionTree] = []
         self._w = None  # quadratic fallback weights
 
@@ -151,7 +152,7 @@ class CostModel:
         return out
 
     def fit(self, X: List[List[float]], y: List[float]) -> None:
-        self._trees, self._w = [], None
+        self._trees, self._w, self._boosted = [], None, False
         if len(X) < 3:
             return
         Xa = np.asarray(X, np.float64)
@@ -161,6 +162,7 @@ class CostModel:
             self._w, *_ = np.linalg.lstsq(A, ya, rcond=None)
             return
         self._base = float(ya.mean())
+        self._boosted = True
         pred = np.full(len(ya), self._base)
         for _ in range(self.n_trees):
             resid = ya - pred
@@ -174,7 +176,7 @@ class CostModel:
             self._trees.append(tree)
 
     def predict(self, f: List[float]) -> float:
-        if self._trees:
+        if self._boosted:  # 0 trees = flat metrics; the mean IS the fit
             x = np.asarray(f, np.float64)
             return self._base + self.learning_rate * sum(
                 t.predict_one(x) for t in self._trees)

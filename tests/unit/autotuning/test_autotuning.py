@@ -66,13 +66,21 @@ class TestTuners:
         best, m = t.tune()
         assert best["ds_config"]["train_micro_batch_size_per_gpu"] == 8
 
-    def test_cost_model_fits_quadratic(self):
+    def test_cost_model_boosted_trees_fit_quadratic(self):
         cm = CostModel()
         X = [[float(i), 1.0, 0.0] for i in range(8)]
         y = [-(i - 4.0) ** 2 for i in range(8)]
         cm.fit(X, y)
+        assert cm._trees, "8 samples must take the boosted-tree path"
         preds = [cm.predict([float(i), 1.0, 0.0]) for i in range(8)]
         assert int(np.argmax(preds)) == 4
+
+    def test_cost_model_flat_metrics_predict_the_mean(self):
+        cm = CostModel()
+        X = [[float(i), 1.0, 0.0] for i in range(8)]
+        cm.fit(X, [5.0] * 8)  # zero-residual: no trees grown
+        assert cm._boosted and not cm._trees
+        assert abs(cm.predict([3.0, 1.0, 0.0]) - 5.0) < 1e-9
 
     def test_cost_model_boosted_trees_fit_nonsmooth_interaction(self):
         """The GBDT surrogate must rank a cliff + interaction surface a

@@ -162,6 +162,29 @@ def test_moe_model_trains(k):
     assert float(loss) < l0
 
 
+def test_index_dispatch_emits_expert_all_to_all():
+    """The scatter/gather dispatch must still hand XLA a tensor whose
+    expert dim moves onto the expert axis — the compiled EP program needs
+    the all-to-all (or equivalent collective-permute pair) the reference
+    issues explicitly (_AllToAll, sharded_moe.py:90)."""
+    mesh = initialize_mesh(data=2, expert=4)
+    model = MoEModel(num_experts=4)
+    rules = ShardingRules(moe_sharding_rules())
+    engine, _, _, _ = ds.initialize(model=model, config=base_config(micro=2),
+                                    sharding_rules=rules, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(16, 8, 16)).astype(np.float32),
+             "y": rng.normal(size=(16, 8)).astype(np.float32)}
+    stacked = engine._stack_micro_batches(batch)
+    if engine.state is None:
+        first = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        engine._build_state(engine._init_params_from_batch(first))
+    hlo = engine._jit_train_batch.lower(engine.state, stacked) \
+        .compile().as_text()
+    assert ("all-to-all" in hlo) or ("collective-permute" in hlo), \
+        "no cross-expert collective in the compiled EP step"
+
+
 def test_moe_expert_parallel_mesh():
     """MoE over a mesh with a real expert axis: ep=4, dp=2."""
     mesh = initialize_mesh(data=2, expert=4)
