@@ -43,9 +43,13 @@ class GPTMoEConfig:
     # use_rts, sharded_moe.py: breaks position bias; draws the "gating"
     # rng in train mode). False = deterministic position-order dropping
     use_rts: bool = True
-    # "index" (scatter/gather, TPU-native default) or "einsum" (the
-    # reference's dense one-hot dispatch) — see moe/sharded_moe.py
-    moe_dispatch_mode: str = "index"
+    # "auto" (einsum for k=1, index for k>=2 — the measured per-k policy),
+    # "index" (scatter/gather), or "einsum" (the reference's dense one-hot
+    # dispatch) — see moe/layer.py and BASELINE.md round-5 MoE rows
+    moe_dispatch_mode: str = "auto"
+    # PR-MoE residual blend (arXiv:2201.05596): dense expert + learned
+    # per-token coefficient alongside each MoE block
+    use_residual: bool = False
     aux_loss_weight: float = 0.01
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-5
@@ -84,6 +88,7 @@ class _Block(nn.Module):
                 k=cfg.k, capacity_factor=cfg.capacity_factor,
                 drop_tokens=cfg.drop_tokens, use_rts=cfg.use_rts,
                 dispatch_mode=cfg.moe_dispatch_mode,
+                use_residual=cfg.use_residual, dtype=cfg.dtype,
                 name="moe")(
                     ln2(x), deterministic=deterministic)
             x = x + moe_out

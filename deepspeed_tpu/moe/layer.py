@@ -25,8 +25,9 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_mod
-from .sharded_moe import (combine_indexed, combine_output, dispatch_indexed,
-                          expert_counts, gate_and_dispatch, gate_decisions)
+from .sharded_moe import (_capacity, combine_indexed, combine_output,
+                          dispatch_indexed, expert_counts, gate_and_dispatch,
+                          gate_decisions)
 
 
 def moe_sharding_rules(prefix: str = ""):
@@ -69,10 +70,22 @@ class MoE(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
-    # "index" (default): scatter/gather dispatch, O(S·M) — no (S,E,C)
-    # tensor, no S·E·C·M einsum. "einsum": the reference's dense one-hot
-    # form. Routing is identical (both consume the same GateDecisions).
-    dispatch_mode: str = "index"
+    # Residual MoE (PR-MoE, arXiv:2201.05596; reference layer.py:77,116):
+    # a dense expert-shaped MLP runs alongside the MoE and the two outputs
+    # are blended by a learned per-token softmax coefficient
+    use_residual: bool = False
+    # "auto" (default, measured policy — BASELINE.md round-5 MoE rows):
+    # "einsum" for k=1 (the dense one-hot dispatch is a bf16 MXU matmul
+    # and beats the scatter at top-1 capacity) UNLESS the dense form's
+    # (S,E,C) tensor would exceed ``auto_index_threshold`` elements
+    # (it grows ~quadratically with S); "index" (scatter/gather, O(S·M))
+    # for k>=2 — 1.19-1.21x the einsum form at the NLG recipe shape —
+    # and for any k at long S. Routing is identical in all modes (both
+    # forms consume the same GateDecisions).
+    dispatch_mode: str = "auto"
+    # max elements of the dense (S,E,C) dispatch tensor before "auto"
+    # forces the index form (2^30 fp32 elements = 4 GB per MoE layer)
+    auto_index_threshold: int = 2 ** 30
     expert_cls: Type[nn.Module] = ExpertMLP
     expert_kwargs: Optional[dict] = None
     dtype: Any = jnp.float32
@@ -87,13 +100,22 @@ class MoE(nn.Module):
         gate_logits = nn.Dense(self.num_experts, use_bias=False, name="gate",
                                dtype=jnp.float32)(tokens.astype(jnp.float32))
 
-        if self.dispatch_mode not in ("index", "einsum"):
-            raise ValueError(f"dispatch_mode must be 'index' or 'einsum', "
-                             f"got {self.dispatch_mode!r}")
+        if self.dispatch_mode not in ("auto", "index", "einsum"):
+            raise ValueError(f"dispatch_mode must be 'auto', 'index' or "
+                             f"'einsum', got {self.dispatch_mode!r}")
         rng = self.make_rng("gating") if self.has_rng("gating") else None
         cap_factor = self.capacity_factor if not deterministic \
             else self.eval_capacity_factor
-        if self.dispatch_mode == "index":
+        dispatch_mode = self.dispatch_mode
+        if dispatch_mode == "auto":
+            S = tokens.shape[0]
+            cap = S if not self.drop_tokens else _capacity(
+                S, self.num_experts, self.k * cap_factor, self.min_capacity)
+            dense_elems = S * self.num_experts * cap
+            dispatch_mode = "einsum" if (
+                self.k == 1 and dense_elems <= self.auto_index_threshold) \
+                else "index"
+        if dispatch_mode == "index":
             dec = gate_decisions(
                 gate_logits, k=self.k, capacity_factor=cap_factor,
                 min_capacity=self.min_capacity,
@@ -134,10 +156,20 @@ class MoE(nn.Module):
         # all-to-all back before combine
         expert_out = jax.lax.with_sharding_constraint(
             expert_out, NamedSharding(mesh, P(mesh_mod.EXPERT_AXIS, None, None)))
-        if self.dispatch_mode == "index":
+        if dispatch_mode == "index":
             out = combine_indexed(expert_out, dec)
             exp_counts = expert_counts(dec, self.num_experts)
         else:
             out = combine_output(expert_out, combine)
             exp_counts = jnp.sum(combine > 0, axis=(0, 2))  # tokens per expert
+
+        if self.use_residual:
+            # PR-MoE: out = coef0 * moe_out + coef1 * dense_mlp(x), with
+            # coef = softmax(Linear(hidden, 2)(x)) per token
+            mlp_out = self.expert_cls(**kwargs, name="residual_mlp")(tokens)
+            coef = nn.Dense(2, dtype=jnp.float32, name="coefficient")(
+                tokens.astype(jnp.float32))
+            coef = jax.nn.softmax(coef, axis=-1).astype(out.dtype)
+            out = out * coef[:, 0:1] + mlp_out.astype(out.dtype) * coef[:, 1:2]
+
         return out.reshape(orig_shape).astype(x.dtype), aux_loss, exp_counts

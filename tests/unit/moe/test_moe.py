@@ -134,6 +134,8 @@ class MoEModel(nn.Module):
     hidden: int = 16
     num_experts: int = 4
     k: int = 1
+    use_residual: bool = False
+    dispatch_mode: str = "auto"
 
     @nn.compact
     def __call__(self, batch, deterministic: bool = False):
@@ -141,6 +143,8 @@ class MoEModel(nn.Module):
         x = nn.Dense(self.hidden, name="in_proj")(x)
         moe_out, aux, _ = MoE(hidden_size=self.hidden, num_experts=self.num_experts,
                               k=self.k, capacity_factor=2.0, drop_tokens=False,
+                              use_residual=self.use_residual,
+                              dispatch_mode=self.dispatch_mode,
                               name="moe")(x, deterministic=deterministic)
         out = nn.Dense(1, name="head")(moe_out)
         loss = jnp.mean((out.squeeze(-1) - batch["y"]) ** 2)
@@ -162,13 +166,95 @@ def test_moe_model_trains(k):
     assert float(loss) < l0
 
 
+@pytest.mark.parametrize("k,resolved", [(1, "einsum"), (2, "index")])
+def test_auto_dispatch_mode_resolves_per_k(k, resolved):
+    """'auto' = einsum for k=1, index for k>=2 (the measured policy);
+    the output must equal the explicitly-selected form bitwise."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 16))
+
+    def build(mode):
+        return MoE(hidden_size=16, num_experts=4, k=k, capacity_factor=2.0,
+                   dispatch_mode=mode)
+
+    params = build("auto").init(jax.random.PRNGKey(1), x)
+    out_a, aux_a, cnt_a = build("auto").apply(params, x)
+    out_r, aux_r, cnt_r = build(resolved).apply(params, x)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_r))
+    np.testing.assert_array_equal(np.asarray(cnt_a), np.asarray(cnt_r))
+
+
+def test_auto_dispatch_forces_index_above_dense_size_threshold():
+    """At long S the dense (S,E,C) form is quadratic in S — 'auto' must
+    fall back to index even at k=1 (threshold shrunk to make it bite)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 16))  # S=32
+
+    def build(mode, thresh):
+        return MoE(hidden_size=16, num_experts=4, k=1, capacity_factor=2.0,
+                   dispatch_mode=mode, auto_index_threshold=thresh)
+
+    # S*E*C = 32*4*16 = 2048 dense elements; threshold below that → index
+    params = build("auto", 2047).init(jax.random.PRNGKey(1), x)
+    out_a, _, cnt_a = build("auto", 2047).apply(params, x)
+    out_i, _, cnt_i = build("index", 2047).apply(params, x)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_i))
+    np.testing.assert_array_equal(np.asarray(cnt_a), np.asarray(cnt_i))
+
+
+def test_residual_moe_blends_dense_and_expert_paths():
+    """PR-MoE (use_residual, arXiv:2201.05596; reference layer.py:77,116):
+    out = coef0 * moe_out + coef1 * dense_mlp(x) with a learned per-token
+    softmax coefficient."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 16))
+    moe = MoE(hidden_size=16, num_experts=4, k=1, capacity_factor=2.0,
+              use_residual=True)
+    params = moe.init(jax.random.PRNGKey(1), x)
+    p = params["params"]
+    assert "residual_mlp" in p and "coefficient" in p
+    assert p["coefficient"]["kernel"].shape == (16, 2)
+
+    out, aux, _ = moe.apply(params, x)
+    assert out.shape == x.shape and np.isfinite(np.asarray(out)).all()
+
+    # reconstruct the blend from the submodule outputs: must match exactly
+    base = MoE(hidden_size=16, num_experts=4, k=1, capacity_factor=2.0,
+               use_residual=False)
+    base_params = {"params": {k: v for k, v in p.items()
+                              if k not in ("residual_mlp", "coefficient")}}
+    moe_out, _, _ = base.apply(base_params, x)
+    tokens = x.reshape(-1, 16)
+    from deepspeed_tpu.moe.layer import ExpertMLP
+    mlp_out = ExpertMLP(hidden_size=16, intermediate_size=64).apply(
+        {"params": p["residual_mlp"]}, tokens)
+    coef = jax.nn.softmax(
+        tokens @ p["coefficient"]["kernel"] + p["coefficient"]["bias"],
+        axis=-1)
+    expect = (moe_out.reshape(-1, 16) * coef[:, 0:1]
+              + mlp_out * coef[:, 1:2]).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_residual_moe_model_trains():
+    model = MoEModel(k=1, use_residual=True)
+    rules = ShardingRules(moe_sharding_rules())
+    engine, _, _, _ = ds.initialize(model=model, config=base_config(micro=2),
+                                    sharding_rules=rules)
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(16, 8, 16)).astype(np.float32),
+             "y": rng.normal(size=(16, 8)).astype(np.float32)}
+    l0 = float(engine.train_batch(batch=batch))
+    for _ in range(8):
+        loss = engine.train_batch(batch=batch)
+    assert float(loss) < l0
+
+
 def test_index_dispatch_emits_expert_all_to_all():
     """The scatter/gather dispatch must still hand XLA a tensor whose
     expert dim moves onto the expert axis — the compiled EP program needs
     the all-to-all (or equivalent collective-permute pair) the reference
     issues explicitly (_AllToAll, sharded_moe.py:90)."""
     mesh = initialize_mesh(data=2, expert=4)
-    model = MoEModel(num_experts=4)
+    model = MoEModel(num_experts=4, dispatch_mode="index")
     rules = ShardingRules(moe_sharding_rules())
     engine, _, _, _ = ds.initialize(model=model, config=base_config(micro=2),
                                     sharding_rules=rules, mesh=mesh)
