@@ -83,19 +83,28 @@ class MoE(nn.Module):
     # and for any k at long S. Routing is identical in all modes (both
     # forms consume the same GateDecisions).
     dispatch_mode: str = "auto"
-    # max elements of the dense (S,E,C) dispatch tensor before "auto"
-    # forces the index form (2^30 fp32 elements = 4 GB per MoE layer)
-    auto_index_threshold: int = 2 ** 30
+    # max elements of the dense (S,E,C) form before "auto" forces the
+    # index form. The einsum path materializes BOTH the fp32 combine and
+    # the token-dtype dispatch tensor (live through backward), so budget
+    # ~2x per element: 2^29 elements ≈ 2 GB combine + ~1-2 GB dispatch
+    # per MoE layer
+    auto_index_threshold: int = 2 ** 29
     expert_cls: Type[nn.Module] = ExpertMLP
     expert_kwargs: Optional[dict] = None
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, *, used_token=None, deterministic: bool = True):
+        """x (..., hidden); ``used_token`` (keyword-only, broadcastable to
+        x's token dims) masks padding tokens out of top-1 routing and the
+        aux loss (reference layer.py:100 forward arg → sharded_moe.py:202)."""
         orig_shape = x.shape
         M = orig_shape[-1]
         assert M == self.hidden_size
         tokens = x.reshape(-1, M)
+        if used_token is not None:
+            used_token = jnp.broadcast_to(
+                used_token, orig_shape[:-1]).reshape(-1)
 
         gate_logits = nn.Dense(self.num_experts, use_bias=False, name="gate",
                                dtype=jnp.float32)(tokens.astype(jnp.float32))
@@ -121,7 +130,8 @@ class MoE(nn.Module):
                 min_capacity=self.min_capacity,
                 noisy_gate_policy=(self.noisy_gate_policy
                                    if not deterministic else None),
-                drop_tokens=self.drop_tokens, use_rts=self.use_rts, rng=rng)
+                drop_tokens=self.drop_tokens, use_rts=self.use_rts, rng=rng,
+                used_token=used_token)
             aux_loss = dec.aux_loss
             dispatched = dispatch_indexed(tokens, dec, self.num_experts)
             combine = None
@@ -132,7 +142,8 @@ class MoE(nn.Module):
                 min_capacity=self.min_capacity,
                 noisy_gate_policy=(self.noisy_gate_policy
                                    if not deterministic else None),
-                drop_tokens=self.drop_tokens, use_rts=self.use_rts, rng=rng)
+                drop_tokens=self.drop_tokens, use_rts=self.use_rts, rng=rng,
+                used_token=used_token)
 
         # Move expert dim onto the expert axis: XLA emits the all-to-all here
         # (≅ reference _AllToAll before expert compute, sharded_moe.py:90)

@@ -79,10 +79,13 @@ def top1_decisions(logits: jnp.ndarray,
                    noisy_gate_policy: Optional[str] = None,
                    drop_tokens: bool = True,
                    use_rts: bool = True,
-                   rng: Optional[jax.Array] = None) -> GateDecisions:
+                   rng: Optional[jax.Array] = None,
+                   used_token: Optional[jnp.ndarray] = None) -> GateDecisions:
     """Top-1 routing decisions (≅ reference sharded_moe.py:179).
 
     Random token selection (``use_rts``) breaks position bias when dropping.
+    ``used_token`` (S,) masks padding tokens out of routing and the aux
+    loss (reference sharded_moe.py:202-203; top-1 only, as there).
     """
     S, E = logits.shape
     capacity = _capacity(S, E, capacity_factor, min_capacity)
@@ -98,6 +101,8 @@ def top1_decisions(logits: jnp.ndarray,
     gates = jax.nn.softmax(logits, axis=1)
     indices1 = jnp.argmax(logits_for_selection, axis=1)
     mask1 = _one_hot(indices1, E)  # (S, E)
+    if used_token is not None:
+        mask1 = mask1 * used_token.astype(mask1.dtype)[:, None]
 
     # load-balancing aux loss: E * mean_e(fraction_tokens_e * mean_gate_e)
     me = jnp.mean(gates, axis=0)
@@ -187,11 +192,15 @@ def gate_decisions(logits: jnp.ndarray, k: int = 1,
                    capacity_factor: float = 1.0, min_capacity: int = 4,
                    noisy_gate_policy: Optional[str] = None,
                    drop_tokens: bool = True, use_rts: bool = True,
-                   rng: Optional[jax.Array] = None) -> GateDecisions:
-    """Top-k routing decisions (dispatcher over top1/top2)."""
+                   rng: Optional[jax.Array] = None,
+                   used_token: Optional[jnp.ndarray] = None) -> GateDecisions:
+    """Top-k routing decisions (dispatcher over top1/top2). ``used_token``
+    applies to top-1 only (the reference's TopKGate likewise forwards it
+    only to top1gating, sharded_moe.py:406)."""
     if k == 1:
         return top1_decisions(logits, capacity_factor, min_capacity,
-                              noisy_gate_policy, drop_tokens, use_rts, rng)
+                              noisy_gate_policy, drop_tokens, use_rts, rng,
+                              used_token=used_token)
     if k == 2:
         return top2_decisions(logits, capacity_factor, min_capacity,
                               drop_tokens, rng)
@@ -220,13 +229,15 @@ def top1gating(logits: jnp.ndarray,
                drop_tokens: bool = True,
                use_rts: bool = True,
                rng: Optional[jax.Array] = None,
+               used_token: Optional[jnp.ndarray] = None,
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
     """Top-1 gating, dense form (≅ reference sharded_moe.py:179).
 
     Returns (aux_loss, combine_weights (S,E,C), dispatch_mask (S,E,C), capacity).
     """
     dec = top1_decisions(logits, capacity_factor, min_capacity,
-                         noisy_gate_policy, drop_tokens, use_rts, rng)
+                         noisy_gate_policy, drop_tokens, use_rts, rng,
+                         used_token=used_token)
     combine, dispatch = _densify(dec, logits.shape[1], logits.dtype)
     return dec.aux_loss, combine, dispatch, dec.capacity
 
@@ -292,7 +303,8 @@ def gate_and_dispatch(tokens: jnp.ndarray, gate_logits: jnp.ndarray, k: int = 1,
                       capacity_factor: float = 1.0, min_capacity: int = 4,
                       noisy_gate_policy: Optional[str] = None,
                       drop_tokens: bool = True, use_rts: bool = True,
-                      rng: Optional[jax.Array] = None):
+                      rng: Optional[jax.Array] = None,
+                      used_token: Optional[jnp.ndarray] = None):
     """tokens (S, M) + logits (S, E) → (aux_loss, dispatched (E, C, M),
     combine (S, E, C)). The dispatch einsum is the reference's
     ``einsum("sec,sm->ecm")`` (sharded_moe.py:420 area). Dense form; the
@@ -301,7 +313,7 @@ def gate_and_dispatch(tokens: jnp.ndarray, gate_logits: jnp.ndarray, k: int = 1,
     if k == 1:
         aux, combine, dispatch, _ = top1gating(
             gate_logits, capacity_factor, min_capacity, noisy_gate_policy,
-            drop_tokens, use_rts, rng)
+            drop_tokens, use_rts, rng, used_token=used_token)
     elif k == 2:
         aux, combine, dispatch, _ = top2gating(
             gate_logits, capacity_factor, min_capacity, drop_tokens, rng)

@@ -200,6 +200,32 @@ def test_auto_dispatch_forces_index_above_dense_size_threshold():
     np.testing.assert_array_equal(np.asarray(cnt_a), np.asarray(cnt_i))
 
 
+@pytest.mark.parametrize("mode", ["index", "einsum"])
+def test_used_token_masks_padding_out_of_routing(mode):
+    """Reference MoE.forward(used_token) (layer.py:100, sharded_moe.py:202):
+    masked tokens must get zero MoE output and not occupy expert capacity."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    used = np.ones((2, 8), np.float32)
+    used[:, 4:] = 0.0  # second half of every row is padding
+    # apply() below runs deterministic (eval) mode → eval_capacity_factor
+    moe = MoE(hidden_size=16, num_experts=4, k=1, capacity_factor=2.0,
+              eval_capacity_factor=2.0, dispatch_mode=mode)
+    params = moe.init(jax.random.PRNGKey(1), x)
+    out_m, aux_m, cnt_m = moe.apply(params, x,
+                                    used_token=jnp.asarray(used))
+    out_f, aux_f, cnt_f = moe.apply(params, x)
+
+    # padding rows produce exactly zero expert output
+    np.testing.assert_array_equal(np.asarray(out_m)[:, 4:], 0.0)
+    # real rows route identically to the unmasked case (capacity 2.0 is
+    # ample, so no displacement happens here)
+    np.testing.assert_allclose(np.asarray(out_m)[:, :4],
+                               np.asarray(out_f)[:, :4],
+                               rtol=1e-5, atol=1e-6)
+    assert int(np.asarray(cnt_m).sum()) == 8  # only real tokens counted
+    assert float(aux_m) != float(aux_f)  # padding left the balance stats
+
+
 def test_residual_moe_blends_dense_and_expert_paths():
     """PR-MoE (use_residual, arXiv:2201.05596; reference layer.py:77,116):
     out = coef0 * moe_out + coef1 * dense_mlp(x) with a learned per-token
