@@ -71,6 +71,9 @@ class TransformerConfig:
     # alibi and train-mode attention dropout stay on the einsum path)
     remat: bool = False
     decode_kernel: str = "auto"         # auto | on | off (fused Pallas decode)
+    kv_cache_quant: bool = False        # int8 KV cache (per-row scales):
+    # halves the cache's HBM traffic — the resource decode is bound by —
+    # and halves KV memory, doubling the servable context per chip
     int8_weights: bool = False          # serve with int8-at-rest Dense kernels
     int8_kernel: str = "auto"           # auto | on | off (Pallas dequant-GEMM)
     int8_head: bool = False             # quantize lm_head too (off: the vocab
@@ -256,11 +259,18 @@ class CachedAttention(nn.Module):
         if decode:
             # cache layout (B, KV, S, D): per-head (S, D) contiguous — the
             # TPU-friendly layout the fused decode kernel requires (S on
-            # sublanes, D on lanes)
+            # sublanes, D on lanes). With kv_cache_quant the cache holds
+            # int8 rows + per-row fp32 scales (quantize_kv_rows)
+            cache_dtype = jnp.int8 if cfg.kv_cache_quant else cfg.dtype
             ck = self.variable("cache", "k", jnp.zeros,
-                               (B, KV, cfg.max_seq_len, D), cfg.dtype)
+                               (B, KV, cfg.max_seq_len, D), cache_dtype)
             cv = self.variable("cache", "v", jnp.zeros,
-                               (B, KV, cfg.max_seq_len, D), cfg.dtype)
+                               (B, KV, cfg.max_seq_len, D), cache_dtype)
+            if cfg.kv_cache_quant:
+                cks = self.variable("cache", "k_scale", jnp.zeros,
+                                    (B, KV, cfg.max_seq_len), jnp.float32)
+                cvs = self.variable("cache", "v_scale", jnp.zeros,
+                                    (B, KV, cfg.max_seq_len), jnp.float32)
             cidx = self.variable("cache", "index",
                                  lambda: jnp.zeros((), jnp.int32))
             start = cidx.value
@@ -275,30 +285,48 @@ class CachedAttention(nn.Module):
             k = apply_rotary(k, positions, rotary_dim=rd, theta=cfg.rope_theta)
 
         if decode:
+            k_rows = k.astype(cfg.dtype).transpose(0, 2, 1, 3)  # (B,KV,T,D)
+            v_rows = v.astype(cfg.dtype).transpose(0, 2, 1, 3)
+            if cfg.kv_cache_quant:
+                from ..ops.attention.decode_attention import quantize_kv_rows
+
+                k_rows, k_sc = quantize_kv_rows(k_rows)
+                v_rows, v_sc = quantize_kv_rows(v_rows)
+                cks.value = jax.lax.dynamic_update_slice(
+                    cks.value, k_sc, (0, 0, start))
+                cvs.value = jax.lax.dynamic_update_slice(
+                    cvs.value, v_sc, (0, 0, start))
             ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype).transpose(0, 2, 1, 3),
-                (0, 0, start, 0))
+                ck.value, k_rows, (0, 0, start, 0))
             cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype).transpose(0, 2, 1, 3),
-                (0, 0, start, 0))
+                cv.value, v_rows, (0, 0, start, 0))
             cidx.value = start + T
             k_all, v_all = ck.value, cv.value  # (B, KV, S, D)
             S = cfg.max_seq_len
             if T == 1 and self._use_decode_kernel(S, deterministic):
                 # fused Pallas decode attention (reference softmax_context,
                 # pt_binding.cpp:1910-1975): length masking + softmax +
-                # value reduction in one pass over the cache
+                # value reduction in one pass over the cache; int8 caches
+                # pass their per-row scales straight through
                 from ..ops.attention.decode_attention import (
                     decode_attention,
                     pick_block_s,
                 )
 
                 slopes = alibi_slopes(H) if cfg.pos_emb == "alibi" else None
+                scales = dict(k_scale=cks.value, v_scale=cvs.value) \
+                    if cfg.kv_cache_quant else {}
                 y = decode_attention(
                     q[:, 0].astype(cfg.dtype), k_all, v_all, start + 1,
-                    alibi_slopes=slopes, block_s=pick_block_s(S))
+                    alibi_slopes=slopes, block_s=pick_block_s(S), **scales)
                 y = y.astype(cfg.dtype).reshape(B, 1, H * D)
                 return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
+            if cfg.kv_cache_quant:
+                # einsum fallback (prefill / multi-token): dequantize rows
+                k_all = (k_all.astype(jnp.float32)
+                         * cks.value[..., None]).astype(cfg.dtype)
+                v_all = (v_all.astype(jnp.float32)
+                         * cvs.value[..., None]).astype(cfg.dtype)
             # row t may see cache slots [0, start+t]
             mask = (jnp.arange(S)[None, :] <= (start + jnp.arange(T))[:, None])
         else:

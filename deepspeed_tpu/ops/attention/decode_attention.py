@@ -48,10 +48,26 @@ def pick_block_s(cache_len: int, preferred: int = DEFAULT_BLOCK_S) -> int:
     return block
 
 
+def quantize_kv_rows(x: jax.Array):
+    """Per-row symmetric int8 quantization over the last axis: returns
+    (int8 values, fp32 scales) with ``x ≈ int8 * scale[..., None]``.
+    The KV-cache quantizer: one scale per (batch, kv-head, position)."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, scale: float, block_s: int,
-                   alibi: bool):
-    # len_ref/slope_ref are scalar-prefetch SMEM arrays: (B,) and (H,)
+                   alibi: bool, compute_dtype=None,
+                   k_scale_ref=None, v_scale_ref=None):
+    # len_ref/slope_ref are scalar-prefetch SMEM arrays: (B,) and (H,).
+    # With an int8-quantized cache, k_scale_ref/v_scale_ref carry the
+    # per-row (per token, per kv-head) dequantization scales and are
+    # threaded in as extra INPUT refs (before o_ref at call time; bound
+    # here by keyword from the wrapper's arg shuffle).
     j = pl.program_id(2)
     num_s = pl.num_programs(2)
     length = len_ref[pl.program_id(0)]
@@ -66,14 +82,23 @@ def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(block_start < length)
     def _compute():
-        # MXU operands stay in the input dtype (bf16 at full rate on
-        # v5e); fp32 stats/accumulator; scale applied to fp32 s
+        # MXU operands stay in the compute dtype (bf16 at full rate on
+        # v5e); fp32 stats/accumulator; scale applied to fp32 s.
+        # int8 path: int8 values <= 127 are EXACT in bf16, so the cache
+        # casts losslessly and the dequant scales fold into the score row
+        # (k) and the probability row (v) — two (SUBLANES, block_s) VPU
+        # multiplies instead of dequantizing the (block_s, D) blocks.
         q = q_ref[0]                                      # (1, D)
         qb = jnp.broadcast_to(q, (SUBLANES, q.shape[-1]))
         k = k_ref[0, 0]                                   # (block_s, D)
         v = v_ref[0, 0]
+        if k_scale_ref is not None:
+            k = k.astype(compute_dtype)
+            v = v.astype(compute_dtype)
         s = jax.lax.dot_general(qb, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if k_scale_ref is not None:
+            s = s * k_scale_ref[0, 0]                     # (1, block_s) scale
         pos = block_start + jax.lax.broadcasted_iota(
             jnp.int32, (SUBLANES, block_s), 1)
         if alibi:
@@ -88,6 +113,8 @@ def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = jnp.broadcast_to(
             alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape)
+        if v_scale_ref is not None:
+            p = p * v_scale_ref[0, 0]                     # (1, block_s) scale
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -102,26 +129,43 @@ def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
 def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      lengths: jax.Array, *, scale: Optional[float] = None,
                      alibi_slopes: Optional[jax.Array] = None,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None,
                      block_s: int = DEFAULT_BLOCK_S) -> jax.Array:
     """Single-token cached attention: softmax(q·K^T + bias) · V.
 
     Args:
       q: (B, H, D) current-step queries.
-      k_cache/v_cache: (B, KV, S, D) with H % KV == 0 (GQA).
+      k_cache/v_cache: (B, KV, S, D) with H % KV == 0 (GQA). May be int8
+        (quantized KV cache) when ``k_scale``/``v_scale`` are given.
       lengths: (B,) or scalar int32 — valid cache slots per sequence
         (INCLUDING the current token, already written to the cache).
       alibi_slopes: optional (H,) ALiBi slopes.
+      k_scale/v_scale: (B, KV, S) fp32 per-row dequantization scales for
+        an int8 cache (row value = int8 * scale). Halves the cache's HBM
+        traffic — the resource decode is bound by; the scales fold into
+        the score/probability rows, so no dequantized (block_s, D) block
+        is ever materialized.
     Returns (B, H, D) in q's dtype.
     """
     B, H, D = q.shape
     _, KV, S, _ = k_cache.shape
     assert H % KV == 0, f"H={H} not a multiple of KV={KV}"
+    assert (k_scale is None) == (v_scale is None), \
+        "provide both k_scale and v_scale or neither"
+    quantized = k_scale is not None
     rep = H // KV
     # MXU operands must share a dtype (the kernel no longer upcasts to
     # fp32 — bf16 runs at full MXU rate); harmonize q to the cache dtype
-    # and restore the caller's dtype on the way out
+    # (for int8 caches the compute dtype is q's own) and restore the
+    # caller's dtype on the way out
     out_dtype = q.dtype
-    q = q.astype(k_cache.dtype)
+    if quantized:
+        compute_dtype = q.dtype if q.dtype == jnp.bfloat16 else jnp.float32
+        q = q.astype(compute_dtype)
+    else:
+        compute_dtype = k_cache.dtype
+        q = q.astype(k_cache.dtype)
     if scale is None:
         scale = 1.0 / math.sqrt(D)
     lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (B,))
@@ -148,14 +192,41 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             (len_ref[b] + block_s - 1) // block_s - 1, 0)
         return (b, h // rep, jnp.minimum(j, last_live), 0)
 
+    def scale_index(b, h, j, len_ref, slope_ref):
+        last_live = jnp.maximum(
+            (len_ref[b] + block_s - 1) // block_s - 1, 0)
+        return (b, h // rep, 0, jnp.minimum(j, last_live))
+
+    in_specs = [
+        pl.BlockSpec((1, 1, D), lambda b, h, j, *_: (b * H + h, 0, 0)),
+        pl.BlockSpec((1, 1, block_s, D), kv_index),
+        pl.BlockSpec((1, 1, block_s, D), kv_index),
+    ]
+    operands = [lengths, slopes, q3, k_cache, v_cache]
+    if quantized:
+        # scales ride as (B, KV, 1, S): the block (1, 1, 1, block_s) puts
+        # them on LANES, matching s/p's lane layout (and Mosaic's tiling
+        # contract — a (1, block_s) trailing block would not tile)
+        in_specs += [pl.BlockSpec((1, 1, 1, block_s), scale_index),
+                     pl.BlockSpec((1, 1, 1, block_s), scale_index)]
+        operands += [k_scale.astype(jnp.float32).reshape(B, KV, 1, S),
+                     v_scale.astype(jnp.float32).reshape(B, KV, 1, S)]
+
+        def kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   o_ref, acc_ref, m_ref, l_ref):
+            _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
+                           acc_ref, m_ref, l_ref, scale=scale,
+                           block_s=block_s, alibi=alibi,
+                           compute_dtype=compute_dtype,
+                           k_scale_ref=ks_ref, v_scale_ref=vs_ref)
+    else:
+        kernel = functools.partial(_decode_kernel, scale=scale,
+                                   block_s=block_s, alibi=alibi)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, D), lambda b, h, j, *_: (b * H + h, 0, 0)),
-            pl.BlockSpec((1, 1, block_s, D), kv_index),
-            pl.BlockSpec((1, 1, block_s, D), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, D),
                                lambda b, h, j, *_: (b * H + h, 0, 0)),
         scratch_shapes=[
@@ -165,10 +236,9 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, block_s=block_s,
-                          alibi=alibi),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
         interpret=_interpret(),
-    )(lengths, slopes, q3, k_cache, v_cache)
+    )(*operands)
     return out.reshape(B, H, D).astype(out_dtype)
