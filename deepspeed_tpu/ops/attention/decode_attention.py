@@ -36,12 +36,21 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import LANES, NEG_INF, SUBLANES, _interpret
 
 DEFAULT_BLOCK_S = 1024
+LONG_CACHE_BLOCK_S = 4096  # >= 8k caches: grid overhead, not bandwidth,
+# bounds the 1024 block — the kv_int8_bench block sweep measures 4096
+# fastest for both bf16 and int8 at 16k (BASELINE.md round-5 KV section);
+# short live lengths only pay one partially-dead block (the index-map
+# clamp elides the rest), a sub-ms cost
 
 
-def pick_block_s(cache_len: int, preferred: int = DEFAULT_BLOCK_S) -> int:
+def pick_block_s(cache_len: int, preferred: Optional[int] = None) -> int:
     """Largest power-of-two block <= preferred that divides the cache
     length (the kernel requires S % block_s == 0). Returns the largest
-    power-of-two divisor when that's below ``preferred``."""
+    power-of-two divisor when that's below ``preferred``. Default
+    preference is length-aware: 1024 below 8k, 4096 from 8k up."""
+    if preferred is None:
+        preferred = LONG_CACHE_BLOCK_S if cache_len >= 8192 \
+            else DEFAULT_BLOCK_S
     block = preferred
     while block > 1 and cache_len % block != 0:
         block //= 2

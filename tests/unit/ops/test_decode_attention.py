@@ -77,6 +77,11 @@ def test_pick_block_s():
     assert pick_block_s(512) == 512
     assert pick_block_s(192) == 64
     assert pick_block_s(100) == 4   # 100 = 4 * 25
+    # length-aware preference: >= 8k caches take the 4096 block the
+    # round-5 sweep measured fastest (kv_int8_results.json block rows)
+    assert pick_block_s(8192) == 4096
+    assert pick_block_s(16384) == 4096
+    assert pick_block_s(4096) == 1024
     assert pick_block_s(97) == 1
 
 
