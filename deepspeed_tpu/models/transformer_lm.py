@@ -284,6 +284,7 @@ class CachedAttention(nn.Module):
             q = apply_rotary(q, positions, rotary_dim=rd, theta=cfg.rope_theta)
             k = apply_rotary(k, positions, rotary_dim=rd, theta=cfg.rope_theta)
 
+        kv_scales = None  # set on the quantized-cache einsum fallback
         if decode:
             k_rows = k.astype(cfg.dtype).transpose(0, 2, 1, 3)  # (B,KV,T,D)
             v_rows = v.astype(cfg.dtype).transpose(0, 2, 1, 3)
@@ -322,11 +323,11 @@ class CachedAttention(nn.Module):
                 y = y.astype(cfg.dtype).reshape(B, 1, H * D)
                 return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
             if cfg.kv_cache_quant:
-                # einsum fallback (prefill / multi-token): dequantize rows
-                k_all = (k_all.astype(jnp.float32)
-                         * cks.value[..., None]).astype(cfg.dtype)
-                v_all = (v_all.astype(jnp.float32)
-                         * cvs.value[..., None]).astype(cfg.dtype)
+                # einsum fallback (prefill / multi-token): do NOT
+                # dequantize the cache (a full-size bf16 copy — multiple
+                # GB at long S); fold the per-row scales into the score
+                # and probability tensors instead, as the kernel does
+                kv_scales = (cks.value, cvs.value)  # (B, KV, S) each
             # row t may see cache slots [0, start+t]
             mask = (jnp.arange(S)[None, :] <= (start + jnp.arange(T))[:, None])
         else:
@@ -354,10 +355,17 @@ class CachedAttention(nn.Module):
             rep = H // KV
             k_all = jnp.repeat(k_all, rep, axis=1)
             v_all = jnp.repeat(v_all, rep, axis=1)
+            if kv_scales is not None:
+                kv_scales = tuple(jnp.repeat(s, rep, axis=1)
+                                  for s in kv_scales)
 
         scale = 1.0 / math.sqrt(D)
+        # int8 cache: the astype fuses into the dot's operand read; the
+        # per-row scales apply to the (B,H,T,S) score/probability tensors
         att = jnp.einsum("bthd,bhsd->bhts", q.astype(jnp.float32),
                          k_all.astype(jnp.float32)) * scale
+        if kv_scales is not None:
+            att = att * kv_scales[0][:, :, None, :]
         if cfg.pos_emb == "alibi":
             slopes = alibi_slopes(H)  # (H,)
             kpos = jnp.arange(S)[None, :]
@@ -367,6 +375,8 @@ class CachedAttention(nn.Module):
         att = jax.nn.softmax(att, axis=-1)
         if cfg.dropout > 0:
             att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+        if kv_scales is not None:
+            att = att * kv_scales[1][:, :, None, :]
         y = jnp.einsum("bhts,bhsd->bthd", att,
                        v_all.astype(jnp.float32)).astype(cfg.dtype)
         y = y.reshape(B, T, H * D)
