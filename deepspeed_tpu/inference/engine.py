@@ -76,6 +76,7 @@ class InferenceEngine:
         self._jit_decode = None
         self._jit_decode_scan = None
         self._jit_sample = None
+        self._decode_scan_execs = {}  # aval-keyed AOT decode executables
         self._cache = None
         self._cache_batch = None
         log_dist(f"InferenceEngine: tp={self.mp_world_size} dtype={self._config.dtype}",
@@ -332,6 +333,53 @@ class InferenceEngine:
 
     __call__ = forward
 
+    def _compile_decode_scan(self, cache_aval, batch, n_steps, top_k, top_p):
+        """AOT-compile the whole-decode program from avals only (no cache
+        buffer live), caching the executable per signature. Returns None
+        when AOT lowering is unavailable so generate() falls back to the
+        plain jit dispatch."""
+        if self.mp_world_size != 1:
+            # TP caches come out of prefill sharded over the model axis;
+            # lowering with replicated avals would produce an executable
+            # that can never match (an expensive dead compile) — skip and
+            # use the plain jit dispatch
+            return None
+        leaves = jax.tree_util.tree_leaves(cache_aval)
+        key = (jax.tree_util.tree_structure(cache_aval),
+               tuple((l.shape, str(l.dtype)) for l in leaves),
+               batch, n_steps, top_k, top_p)
+        if key in self._decode_scan_execs:
+            return self._decode_scan_execs[key]
+        try:
+            rep = NamedSharding(mesh_mod.get_mesh(), PartitionSpec())
+            p_sds = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=a.sharding),
+                self.params)
+            c_sds = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=rep), cache_aval)
+            rng_shape = jax.eval_shape(jax.random.PRNGKey, 0)
+            lowered = self._jit_decode_scan.lower(
+                p_sds, c_sds,
+                jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=rep),
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+                jax.ShapeDtypeStruct(rng_shape.shape, rng_shape.dtype,
+                                     sharding=rep),
+                jax.ShapeDtypeStruct((), jnp.float32, sharding=rep),
+                jax.ShapeDtypeStruct((), jnp.bool_, sharding=rep),
+                n_steps, top_k, top_p)
+            compiled = lowered.compile()
+        except Exception as e:  # noqa: BLE001 — fall back to plain jit
+            # do NOT cache the failure: a transient remote-compile outage
+            # would otherwise disable the precompile path for the
+            # engine's lifetime; the next generate() retries
+            log_dist(f"decode-scan AOT precompile unavailable ({e}); "
+                     f"falling back to jit dispatch", ranks=[0])
+            return None
+        self._decode_scan_execs[key] = compiled
+        return compiled
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: Optional[float] = None,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
@@ -362,18 +410,17 @@ class InferenceEngine:
         top_p = cfg.top_p if top_p is None else top_p
         greedy = jnp.asarray(not do_sample)
 
-        logits, cache = self._jit_prefill(self.params, input_ids)
-        rng = jax.random.PRNGKey(seed)
-        rng, sub = jax.random.split(rng)
-        token = self._jit_sample(logits, sub, jnp.asarray(temperature, jnp.float32),
-                                 int(top_k), float(top_p), greedy)
-
+        # Cache avals from a shape-only prefill: the capacity check and the
+        # decode-program compile both happen BEFORE any cache buffer lives.
         # The allocated KV capacity is the second-from-last dim of the cache
         # k/v leaves — (B, KV, capacity, D), or (L, B, KV, capacity, D) when
         # layers are nn.scan-stacked — authoritative even when the model
         # config lacks max_seq_len. Steps past capacity would write out of
         # bounds (silently clamped by JAX today, but fragile); fail loudly.
-        cache_cap = max((x.shape[-2] for x in jax.tree_util.tree_leaves(cache)
+        _, cache_aval = jax.eval_shape(self._jit_prefill, self.params,
+                                       input_ids)
+        cache_cap = max((x.shape[-2]
+                         for x in jax.tree_util.tree_leaves(cache_aval)
                          if getattr(x, "ndim", 0) >= 4), default=None)
         caps = [c for c in (max_len, cache_cap) if c is not None]
         capacity = min(caps) if caps else None
@@ -382,6 +429,7 @@ class InferenceEngine:
                 f"prompt({T}) + max_new_tokens({max_new_tokens}) exceeds the "
                 f"allocated KV-cache capacity({capacity})")
 
+        decode_exec = None
         if eos_token_id is None:
             # whole-loop compile (CUDA-graph analog): ONE dispatch for the
             # entire decode — per-token host/tunnel latency disappears.
@@ -395,11 +443,42 @@ class InferenceEngine:
             if capacity is not None:
                 bucket = min(bucket, capacity - T - 1)
             bucket = max(bucket, n_steps)
-            _, rest = self._jit_decode_scan(
-                self.params, cache, token.astype(jnp.int32),
-                jnp.asarray(T, jnp.int32), rng,
-                jnp.asarray(temperature, jnp.float32), greedy,
-                bucket, int(top_k), float(top_p))
+            # AOT-compile the decode program NOW, before the prefill cache
+            # exists: the remote compile checks the program's HBM budget
+            # against FREE memory without crediting the dispatch-time
+            # donation of the cache carries, so compiling with buffers
+            # live needs transient 2x-cache headroom (the
+            # kv_capacity_results.json boundary finding). Donation is part
+            # of the lowering, so the dispatch itself aliases as usual.
+            decode_exec = self._compile_decode_scan(
+                cache_aval, B, bucket, int(top_k), float(top_p))
+
+        logits, cache = self._jit_prefill(self.params, input_ids)
+        rng = jax.random.PRNGKey(seed)
+        rng, sub = jax.random.split(rng)
+        token = self._jit_sample(logits, sub, jnp.asarray(temperature, jnp.float32),
+                                 int(top_k), float(top_p), greedy)
+
+        if eos_token_id is None:
+            args = (self.params, cache, token.astype(jnp.int32),
+                    jnp.asarray(T, jnp.int32), rng,
+                    jnp.asarray(temperature, jnp.float32), greedy)
+            rest = None
+            if decode_exec is not None:
+                # small args must match the replicated shardings the
+                # executable was lowered with; the cache comes straight
+                # from prefill — if its layout disagrees (e.g. TP-sharded
+                # caches), fall back to the plain jit dispatch
+                rep = NamedSharding(mesh_mod.get_mesh(), PartitionSpec())
+                try:
+                    placed = (args[0], args[1]) + tuple(
+                        jax.device_put(a, rep) for a in args[2:])
+                    _, rest = decode_exec(*placed)
+                except ValueError:
+                    rest = None
+            if rest is None:
+                _, rest = self._jit_decode_scan(
+                    *args, bucket, int(top_k), float(top_p))
             toks = np.concatenate([np.asarray(token)[:, None],
                                    np.asarray(rest)[:, :n_steps]], axis=1)
         else:

@@ -360,12 +360,20 @@ class CachedAttention(nn.Module):
                                   for s in kv_scales)
 
         scale = 1.0 / math.sqrt(D)
-        # int8 cache: the astype fuses into the dot's operand read; the
-        # per-row scales apply to the (B,H,T,S) score/probability tensors
-        att = jnp.einsum("bthd,bhsd->bhts", q.astype(jnp.float32),
-                         k_all.astype(jnp.float32)) * scale
+        # int8 cache: the s8->f32 cast does NOT fuse into the dot on TPU
+        # (measured: full fp32 cache copies, BASELINE.md round-5 KV
+        # section), so the quantized path casts to the compute dtype
+        # instead — int8 is exact in bf16, the copy is half the bytes,
+        # and the dot still accumulates in f32. The per-row scales apply
+        # to the (B,H,T,S) score/probability tensors.
         if kv_scales is not None:
+            att = jnp.einsum("bthd,bhsd->bhts", q.astype(cfg.dtype),
+                             k_all.astype(cfg.dtype),
+                             preferred_element_type=jnp.float32) * scale
             att = att * kv_scales[0][:, :, None, :]
+        else:
+            att = jnp.einsum("bthd,bhsd->bhts", q.astype(jnp.float32),
+                             k_all.astype(jnp.float32)) * scale
         if cfg.pos_emb == "alibi":
             slopes = alibi_slopes(H)  # (H,)
             kpos = jnp.arange(S)[None, :]
@@ -377,8 +385,13 @@ class CachedAttention(nn.Module):
             att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
         if kv_scales is not None:
             att = att * kv_scales[1][:, :, None, :]
-        y = jnp.einsum("bhts,bhsd->bthd", att,
-                       v_all.astype(jnp.float32)).astype(cfg.dtype)
+            y = jnp.einsum("bhts,bhsd->bthd", att.astype(cfg.dtype),
+                           v_all.astype(cfg.dtype),
+                           preferred_element_type=jnp.float32)
+        else:
+            y = jnp.einsum("bhts,bhsd->bthd", att,
+                           v_all.astype(jnp.float32))
+        y = y.astype(cfg.dtype)
         y = y.reshape(B, T, H * D)
         return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
 
