@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Optional, Tuple
+from typing import Any, Optional, Tuple, Union
 
 import flax.linen as nn
 import jax
@@ -193,10 +193,17 @@ def alibi_slopes(n_head: int) -> jnp.ndarray:
 class CachedAttention(nn.Module):
     """Multi-head / grouped-query attention with optional KV cache.
 
-    Modes:
-      - training / no-cache forward: full causal self-attention.
-      - ``decode=True``: reads+updates the ``cache`` collection
-        (k, v, cache_index); supports multi-token prefill and 1-token decode.
+    Modes (``decode`` is a static tri-state):
+      - ``False`` — training / no-cache forward: full causal
+        self-attention.
+      - ``"prefill"`` — writes the prompt's k/v into the ``cache``
+        collection (k, v, cache_index) and attends over the FRESH
+        prompt k/v (start == 0 contract): O(T) attention memory, never
+        the (B, H, T, max_seq_len) allocated-cache tensor. Use for the
+        first multi-token call.
+      - ``True`` — reads+updates the cache; 1-token decode takes the
+        fused Pallas kernel, multi-token (chunked decode at unknown
+        start) takes the window-masked einsum over the cache.
     """
 
     config: TransformerConfig
@@ -246,7 +253,8 @@ class CachedAttention(nn.Module):
         return jax.default_backend() == "tpu"
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False, deterministic: bool = True):
+    def __call__(self, x, *, decode: Union[bool, str] = False,
+                 deterministic: bool = True):
         cfg = self.config
         B, T, C = x.shape
         H, KV, D = cfg.n_head, cfg.kv_heads, cfg.head_dim
@@ -285,6 +293,12 @@ class CachedAttention(nn.Module):
             k = apply_rotary(k, positions, rotary_dim=rd, theta=cfg.rope_theta)
 
         kv_scales = None  # set on the quantized-cache einsum fallback
+        # "fresh" attention = causal over the just-computed k/v. True for
+        # the training forward AND for prefill (start == 0 contract): the
+        # prompt's causal window IS the fresh k/v, so prefill must NOT
+        # attend over the allocated cache — the (B, H, T, S) score tensor
+        # that implies OOM-crashed the worker at T=4096 / S=8192.
+        fresh = (not decode) or (decode == "prefill" and T > 1)
         if decode:
             k_rows = k.astype(cfg.dtype).transpose(0, 2, 1, 3)  # (B,KV,T,D)
             v_rows = v.astype(cfg.dtype).transpose(0, 2, 1, 3)
@@ -302,9 +316,8 @@ class CachedAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v_rows, (0, 0, start, 0))
             cidx.value = start + T
-            k_all, v_all = ck.value, cv.value  # (B, KV, S, D)
-            S = cfg.max_seq_len
-            if T == 1 and self._use_decode_kernel(S, deterministic):
+            if T == 1 and self._use_decode_kernel(cfg.max_seq_len,
+                                                  deterministic):
                 # fused Pallas decode attention (reference softmax_context,
                 # pt_binding.cpp:1910-1975): length masking + softmax +
                 # value reduction in one pass over the cache; int8 caches
@@ -318,19 +331,25 @@ class CachedAttention(nn.Module):
                 scales = dict(k_scale=cks.value, v_scale=cvs.value) \
                     if cfg.kv_cache_quant else {}
                 y = decode_attention(
-                    q[:, 0].astype(cfg.dtype), k_all, v_all, start + 1,
-                    alibi_slopes=slopes, block_s=pick_block_s(S), **scales)
+                    q[:, 0].astype(cfg.dtype), ck.value, cv.value, start + 1,
+                    alibi_slopes=slopes,
+                    block_s=pick_block_s(cfg.max_seq_len), **scales)
                 y = y.astype(cfg.dtype).reshape(B, 1, H * D)
                 return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
-            if cfg.kv_cache_quant:
-                # einsum fallback (prefill / multi-token): do NOT
-                # dequantize the cache (a full-size bf16 copy — multiple
-                # GB at long S); fold the per-row scales into the score
-                # and probability tensors instead, as the kernel does
-                kv_scales = (cks.value, cvs.value)  # (B, KV, S) each
-            # row t may see cache slots [0, start+t]
-            mask = (jnp.arange(S)[None, :] <= (start + jnp.arange(T))[:, None])
-        else:
+            if not fresh:
+                # chunked decode (decode=True, T > 1, start unknown):
+                # attend over the allocated cache with a window mask
+                k_all, v_all = ck.value, cv.value  # (B, KV, S, D)
+                S = cfg.max_seq_len
+                if cfg.kv_cache_quant:
+                    # do NOT dequantize the cache (a full-size bf16 copy —
+                    # multiple GB at long S); fold the per-row scales into
+                    # the score and probability tensors, as the kernel does
+                    kv_scales = (cks.value, cvs.value)  # (B, KV, S) each
+                # row t may see cache slots [0, start+t]
+                mask = (jnp.arange(S)[None, :]
+                        <= (start + jnp.arange(T))[:, None])
+        if fresh:
             if self._use_flash(T, deterministic):
                 # fused Pallas flash attention for the full-context forward
                 # (and, via its custom_vjp, the streamed/resident backward) —
@@ -422,7 +441,8 @@ class TransformerBlock(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, deterministic: bool = True):
+    def __call__(self, x, decode: Union[bool, str] = False,
+                 deterministic: bool = True):
         cfg = self.config
         a = CachedAttention(cfg, name="attn")(
             _norm(cfg, "ln_1")(x), decode=decode, deterministic=deterministic)
@@ -497,10 +517,13 @@ class TransformerLM(nn.Module):
 
     def prefill(self, input_ids):
         """Run the prompt, filling the KV cache. Call with
-        ``mutable=["cache"]``. Returns (B, T, V) logits."""
+        ``mutable=["cache"]``. Returns (B, T, V) logits. The "prefill"
+        mode contract (start == 0) lets attention run over the fresh
+        prompt k/v (flash for long prompts) instead of the allocated
+        cache — O(T) memory in the prompt, not O(T x max_seq_len)."""
         B, T = input_ids.shape
         pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
-        return self._transform(input_ids, pos, True, True)
+        return self._transform(input_ids, pos, "prefill", True)
 
     def decode(self, input_ids, start_pos):
         """One (or few) token step against the cache; ``start_pos`` is the
