@@ -33,7 +33,8 @@ def run_case(B, H, KV, D, S, block=None):
     import jax.numpy as jnp
 
     from deepspeed_tpu.ops.attention.decode_attention import (
-        decode_attention, pick_block_s, quantize_kv_rows)
+        decode_attention, pack_int8_sublanes, pick_block_s,
+        quantize_kv_rows)
 
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
@@ -42,6 +43,8 @@ def run_case(B, H, KV, D, S, block=None):
     lengths = jnp.full((B,), S, jnp.int32)  # fully live cache
     k8, ks = quantize_kv_rows(k)
     v8, vs = quantize_kv_rows(v)
+    ds = lambda c: c.transpose(0, 1, 3, 2)  # noqa: E731 (B,KV,D,S) layout
+    k, v, k8, v8 = ds(k), ds(v), ds(k8), ds(v8)
     if block is None:
         block = pick_block_s(S)
 
@@ -76,6 +79,10 @@ def run_case(B, H, KV, D, S, block=None):
 
     t_bf16 = med(f_bf16, k, v)
     t_int8 = med(f_int8, k8, v8, ks, vs)
+    # int32-packed container (the kv_cache_packed default): same bytes,
+    # free in-kernel bitcast unpack — times any container overhead
+    t_i32 = med(f_int8, pack_int8_sublanes(k8), pack_int8_sublanes(v8),
+                ks, vs)
     single_bf16 = jax.jit(lambda qq, kk, vv: decode_attention(
         qq, kk, vv, lengths, block_s=block))
     single_int8 = jax.jit(lambda qq, kk, vv, kss, vss: decode_attention(
@@ -90,18 +97,79 @@ def run_case(B, H, KV, D, S, block=None):
         "B": B, "H": H, "KV": KV, "D": D, "cache_len": S, "block_s": block,
         "bf16_ms": round(t_bf16 * 1e3, 3),
         "int8_ms": round(t_int8 * 1e3, 3),
+        "int8_i32packed_ms": round(t_i32 * 1e3, 3),
         "speedup": round(t_bf16 / t_int8, 3),
+        "speedup_i32packed": round(t_bf16 / t_i32, 3),
         "kv_mb_bf16": round(kv_bytes_bf16 / 2 ** 20, 1),
         "kv_mb_int8": round(kv_bytes_int8 / 2 ** 20, 1),
         "max_abs_err": round(err, 4),
     }
 
 
-def main():
-    enable_persistent_cache()
+def run_e2e(key, prompt_len, gen_len, arms=("bf16", "int8"), note=""):
+    """End-to-end generation throughput through the public generate():
+    the measurement behind the ``e2e_generate*`` keys. Arms: bf16 cache,
+    int8 (the kv_cache_packed int32-container default), int8_s8 (the
+    plain-int8 layout, for the container A/B)."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer_lm import (TransformerConfig,
+                                                     TransformerLM)
+
+    B, SMAX = 2, 8192
+    prompts = np.random.default_rng(0).integers(
+        0, 50257, (B, prompt_len)).astype(np.int32)
+    rows = []
+    for arm in arms:
+        cfg = TransformerConfig(
+            vocab_size=50257, max_seq_len=SMAX, n_embd=1024, n_layer=24,
+            n_head=16, kv_cache_quant=arm != "bf16",
+            kv_cache_packed=arm != "int8_s8")
+        eng = ds.init_inference(TransformerLM(cfg), config={"dtype": "bf16"})
+        jax.block_until_ready(  # compile prefill+decode
+            eng.generate(prompts, max_new_tokens=gen_len))
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                eng.generate(prompts, max_new_tokens=gen_len))
+            walls.append(time.perf_counter() - t0)
+        sec = float(np.median(walls))
+        rows.append({"kv": arm, "gen_s": round(sec, 3),
+                     "tok_s": round(B * gen_len / sec, 1)})
+        print(f"[kv_int8] e2e {key} {rows[-1]}", flush=True)
+        del eng
+    out = {"config": {"B": B, "max_seq_len": SMAX, "prompt": prompt_len,
+                      "gen": gen_len, "model": "350m-class", "note": note},
+           "rows": rows}
+    by = {r["kv"]: r["gen_s"] for r in rows}
+    if "bf16" in by and "int8" in by:
+        out["e2e_speedup"] = round(by["bf16"] / by["int8"], 3)
     out_path = os.path.join(os.path.dirname(__file__),
                             "kv_int8_results.json")
-    result = {"iters": ITERS, "rows": []}
+    result = json.load(open(out_path)) if os.path.exists(out_path) else {}
+    result[key] = out
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"[kv_int8] {key} -> {out_path}", flush=True)
+
+
+def main():
+    enable_persistent_cache()
+    if "--e2e" in sys.argv:
+        run_e2e("e2e_generate", 512, 1024,
+                arms=("bf16", "int8", "int8_s8"),
+                note="decode-dominated; live 512->1536")
+        run_e2e("e2e_generate_long_prompt", 4096, 256,
+                note="pre-fix this config OOM-crashed the worker (prefill "
+                     "attended over the allocated cache)")
+        return
+    out_path = os.path.join(os.path.dirname(__file__),
+                            "kv_int8_results.json")
+    result = json.load(open(out_path)) if os.path.exists(out_path) else {}
+    result.update({"iters": ITERS, "rows": []})
     cases = [
         # 350M-flagship head layout (H=16, D=64), growing cache
         (8, 16, 16, 64, 2048, None),

@@ -412,14 +412,15 @@ class InferenceEngine:
 
         # Cache avals from a shape-only prefill: the capacity check and the
         # decode-program compile both happen BEFORE any cache buffer lives.
-        # The allocated KV capacity is the second-from-last dim of the cache
-        # k/v leaves — (B, KV, capacity, D), or (L, B, KV, capacity, D) when
-        # layers are nn.scan-stacked — authoritative even when the model
-        # config lacks max_seq_len. Steps past capacity would write out of
-        # bounds (silently clamped by JAX today, but fragile); fail loudly.
+        # The allocated KV capacity is the LAST dim of the cache k/v
+        # leaves — the positions-minor layout (B, KV, D, capacity), or
+        # (L, B, KV, D, capacity) when layers are nn.scan-stacked —
+        # authoritative even when the model config lacks max_seq_len.
+        # Steps past capacity would write out of bounds (silently clamped
+        # by JAX today, but fragile); fail loudly.
         _, cache_aval = jax.eval_shape(self._jit_prefill, self.params,
                                        input_ids)
-        cache_cap = max((x.shape[-2]
+        cache_cap = max((x.shape[-1]
                          for x in jax.tree_util.tree_leaves(cache_aval)
                          if getattr(x, "ndim", 0) >= 4), default=None)
         caps = [c for c in (max_len, cache_cap) if c is not None]

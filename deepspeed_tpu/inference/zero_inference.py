@@ -112,7 +112,7 @@ class ZeroInferenceEngine:
         def block_fn(layer_params, x):
             if self.pack:
                 layer_params = self._unpack(layer_params)
-            return block.apply({"params": layer_params}, x, False, True)
+            return block.apply({"params": layer_params}, x, False, True)[0]
 
         # NOTE: no input donation here (neither the layer buffer nor the
         # activation). Buffers are freed by refcount (`buffers.pop` +
@@ -122,22 +122,33 @@ class ZeroInferenceEngine:
         # while the identical loop without donation held ~1.5 GB/s.
         self._jit_block = jax.jit(block_fn)
 
-        def cached_block_init_fn(layer_params, x):
-            # first (prefill) pass: flax creates the cache collection
-            # itself — layout, names and dtype stay the module's concern
-            if self.pack:
-                layer_params = self._unpack(layer_params)
-            out, vars_ = block.apply({"params": layer_params}, x, True,
-                                     True, mutable=["cache"])
-            return out, vars_["cache"]
+        from ..models.transformer_lm import make_layer_kv_cache
 
-        def cached_block_fn(layer_params, cache, x):
+        def cached_block_init_fn(layer_params, x):
+            # first (prefill) pass: build this layer's zeroed cache and
+            # thread it explicitly — the block takes/returns the cache as
+            # a value (carry-DUS design; layout/dtype stay the model's
+            # concern via make_layer_kv_cache). "prefill" mode (the
+            # start == 0 contract this fn guarantees) attends over the
+            # fresh prompt k/v — O(T) memory, never the (B, H, T, S)
+            # allocated-cache score tensor that OOMs at long prompts.
             if self.pack:
                 layer_params = self._unpack(layer_params)
-            out, vars_ = block.apply(
-                {"params": layer_params, "cache": cache}, x, True, True,
-                mutable=["cache"])
-            return out, vars_["cache"]
+            cache = dict(make_layer_kv_cache(cfg, x.shape[0]),
+                         start=jnp.zeros((), jnp.int32))
+            out, new_cache = block.apply({"params": layer_params}, x,
+                                         "prefill", True, cache)
+            new_cache.pop("start", None)
+            return out, new_cache
+
+        def cached_block_fn(layer_params, cache, x, start):
+            if self.pack:
+                layer_params = self._unpack(layer_params)
+            out, new_cache = block.apply(
+                {"params": layer_params}, x, True, True,
+                dict(cache, start=start))
+            new_cache.pop("start", None)
+            return out, new_cache
 
         self._jit_cached_block_init = jax.jit(cached_block_init_fn)
         # the cache IS donated: it is device-resident and round-trips
@@ -357,7 +368,8 @@ class ZeroInferenceEngine:
                 if first:
                     x, caches[i] = self._jit_cached_block_init(layer, x)
                 else:
-                    x, caches[i] = self._jit_cached_block(layer, caches[i], x)
+                    x, caches[i] = self._jit_cached_block(
+                        layer, caches[i], x, jnp.asarray(start, jnp.int32))
                 del layer
             return self._jit_head(self._small["embed_tokens"],
                                   self._small["ln_f"],

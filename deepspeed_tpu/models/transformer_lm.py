@@ -74,6 +74,15 @@ class TransformerConfig:
     kv_cache_quant: bool = False        # int8 KV cache (per-row scales):
     # halves the cache's HBM traffic — the resource decode is bound by —
     # and halves KV memory, doubling the servable context per chip
+    kv_cache_packed: bool = True        # store the int8 cache in an int32
+    # container (pack_int8_sublanes: 4 head-dim rows per word, the TPU's
+    # own sublane byte order, so the kernel unpacks with a free
+    # pltpu.bitcast). Same bytes in a natively-tiled dtype — insurance
+    # against Mosaic's (4,1)-packed s8 layout-conversion copies (the
+    # round-4/5 capacity killer; the positions-minor layout + carry-DUS
+    # scan fixed the measured cases, and packed/plain now measure equal —
+    # BASELINE.md round-5 capacity ladder). Only meaningful with
+    # kv_cache_quant; requires head_dim % 4 == 0.
     int8_weights: bool = False          # serve with int8-at-rest Dense kernels
     int8_kernel: str = "auto"           # auto | on | off (Pallas dequant-GEMM)
     int8_head: bool = False             # quantize lm_head too (off: the vocab
@@ -254,7 +263,7 @@ class CachedAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, decode: Union[bool, str] = False,
-                 deterministic: bool = True):
+                 deterministic: bool = True, kv_cache=None):
         cfg = self.config
         B, T, C = x.shape
         H, KV, D = cfg.n_head, cfg.kv_heads, cfg.head_dim
@@ -264,24 +273,19 @@ class CachedAttention(nn.Module):
         k = dense(KV * D, "k_proj")(x).reshape(B, T, KV, D)
         v = dense(KV * D, "v_proj")(x).reshape(B, T, KV, D)
 
+        kv_packed = kv_cache_spec(cfg)[2]
         if decode:
-            # cache layout (B, KV, S, D): per-head (S, D) contiguous — the
-            # TPU-friendly layout the fused decode kernel requires (S on
-            # sublanes, D on lanes). With kv_cache_quant the cache holds
-            # int8 rows + per-row fp32 scales (quantize_kv_rows)
-            cache_dtype = jnp.int8 if cfg.kv_cache_quant else cfg.dtype
-            ck = self.variable("cache", "k", jnp.zeros,
-                               (B, KV, cfg.max_seq_len, D), cache_dtype)
-            cv = self.variable("cache", "v", jnp.zeros,
-                               (B, KV, cfg.max_seq_len, D), cache_dtype)
-            if cfg.kv_cache_quant:
-                cks = self.variable("cache", "k_scale", jnp.zeros,
-                                    (B, KV, cfg.max_seq_len), jnp.float32)
-                cvs = self.variable("cache", "v_scale", jnp.zeros,
-                                    (B, KV, cfg.max_seq_len), jnp.float32)
-            cidx = self.variable("cache", "index",
-                                 lambda: jnp.zeros((), jnp.int32))
-            start = cidx.value
+            # This layer's KV-cache slice arrives as an ARGUMENT (dict
+            # with k/v [+ scales] and the shared ``start``) and the
+            # updated slice is RETURNED — the stacked cache rides the
+            # layer scan's carry with per-layer dynamic-update-slices,
+            # the one pattern XLA reliably keeps in place at any size.
+            # (The previous design — per-layer flax cache variables,
+            # nn.scan variable_axes — lowers to a scan whose xs/ys pair
+            # double-buffers the quantized cache above ~100 MB:
+            # BASELINE.md round-5 capacity section.)
+            assert kv_cache is not None, "decode needs the kv_cache slice"
+            start = kv_cache["start"]
             positions = start + jnp.arange(T)[None, :]
         else:
             start = jnp.zeros((), jnp.int32)
@@ -299,23 +303,34 @@ class CachedAttention(nn.Module):
         # attend over the allocated cache — the (B, H, T, S) score tensor
         # that implies OOM-crashed the worker at T=4096 / S=8192.
         fresh = (not decode) or (decode == "prefill" and T > 1)
+        new_cache = None
+        o_proj = _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")
         if decode:
             k_rows = k.astype(cfg.dtype).transpose(0, 2, 1, 3)  # (B,KV,T,D)
             v_rows = v.astype(cfg.dtype).transpose(0, 2, 1, 3)
+            new_cache = dict(kv_cache)
             if cfg.kv_cache_quant:
-                from ..ops.attention.decode_attention import quantize_kv_rows
+                from ..ops.attention.decode_attention import (
+                    pack_int8_sublanes,
+                    quantize_kv_rows,
+                )
 
                 k_rows, k_sc = quantize_kv_rows(k_rows)
                 v_rows, v_sc = quantize_kv_rows(v_rows)
-                cks.value = jax.lax.dynamic_update_slice(
-                    cks.value, k_sc, (0, 0, start))
-                cvs.value = jax.lax.dynamic_update_slice(
-                    cvs.value, v_sc, (0, 0, start))
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k_rows, (0, 0, start, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v_rows, (0, 0, start, 0))
-            cidx.value = start + T
+                new_cache["k_scale"] = jax.lax.dynamic_update_slice(
+                    kv_cache["k_scale"], k_sc, (0, 0, start))
+                new_cache["v_scale"] = jax.lax.dynamic_update_slice(
+                    kv_cache["v_scale"], v_sc, (0, 0, start))
+            # positions-minor store: new rows become (B, KV, D, T) columns
+            k_cols = k_rows.transpose(0, 1, 3, 2)
+            v_cols = v_rows.transpose(0, 1, 3, 2)
+            if kv_packed:
+                k_cols = pack_int8_sublanes(k_cols)  # (B, KV, D//4, T)
+                v_cols = pack_int8_sublanes(v_cols)
+            new_cache["k"] = jax.lax.dynamic_update_slice(
+                kv_cache["k"], k_cols, (0, 0, 0, start))
+            new_cache["v"] = jax.lax.dynamic_update_slice(
+                kv_cache["v"], v_cols, (0, 0, 0, start))
             if T == 1 and self._use_decode_kernel(cfg.max_seq_len,
                                                   deterministic):
                 # fused Pallas decode attention (reference softmax_context,
@@ -328,24 +343,34 @@ class CachedAttention(nn.Module):
                 )
 
                 slopes = alibi_slopes(H) if cfg.pos_emb == "alibi" else None
-                scales = dict(k_scale=cks.value, v_scale=cvs.value) \
+                scales = dict(k_scale=new_cache["k_scale"],
+                              v_scale=new_cache["v_scale"]) \
                     if cfg.kv_cache_quant else {}
                 y = decode_attention(
-                    q[:, 0].astype(cfg.dtype), ck.value, cv.value, start + 1,
-                    alibi_slopes=slopes,
+                    q[:, 0].astype(cfg.dtype), new_cache["k"],
+                    new_cache["v"], start + 1, alibi_slopes=slopes,
                     block_s=pick_block_s(cfg.max_seq_len), **scales)
                 y = y.astype(cfg.dtype).reshape(B, 1, H * D)
-                return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
+                return o_proj(y), new_cache
             if not fresh:
                 # chunked decode (decode=True, T > 1, start unknown):
                 # attend over the allocated cache with a window mask
-                k_all, v_all = ck.value, cv.value  # (B, KV, S, D)
+                k_all, v_all = new_cache["k"], new_cache["v"]
                 S = cfg.max_seq_len
+                if kv_packed:
+                    from ..ops.attention.decode_attention import \
+                        unpack_int8_sublanes
+
+                    k_all = unpack_int8_sublanes(k_all)
+                    v_all = unpack_int8_sublanes(v_all)
+                # the shared einsum below expects (B, KV, S, D)
+                k_all = k_all.transpose(0, 1, 3, 2)
+                v_all = v_all.transpose(0, 1, 3, 2)
                 if cfg.kv_cache_quant:
                     # do NOT dequantize the cache (a full-size bf16 copy —
                     # multiple GB at long S); fold the per-row scales into
                     # the score and probability tensors, as the kernel does
-                    kv_scales = (cks.value, cvs.value)  # (B, KV, S) each
+                    kv_scales = (new_cache["k_scale"], new_cache["v_scale"])
                 # row t may see cache slots [0, start+t]
                 mask = (jnp.arange(S)[None, :]
                         <= (start + jnp.arange(T))[:, None])
@@ -364,7 +389,7 @@ class CachedAttention(nn.Module):
                                     k_f.astype(cfg.dtype),
                                     v_f.astype(cfg.dtype), causal=True)
                 y = y.astype(cfg.dtype).reshape(B, T, H * D)
-                return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
+                return o_proj(y), new_cache
             k_all = k.transpose(0, 2, 1, 3)  # (B, KV, T, D)
             v_all = v.transpose(0, 2, 1, 3)
             S = T
@@ -412,7 +437,7 @@ class CachedAttention(nn.Module):
                            v_all.astype(jnp.float32))
         y = y.astype(cfg.dtype)
         y = y.reshape(B, T, H * D)
-        return _dense(cfg, C, use_bias=cfg.qkv_bias, name="o_proj")(y)
+        return o_proj(y), new_cache
 
 
 class TransformerMLP(nn.Module):
@@ -442,28 +467,122 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, decode: Union[bool, str] = False,
-                 deterministic: bool = True):
+                 deterministic: bool = True, kv_cache=None):
         cfg = self.config
-        a = CachedAttention(cfg, name="attn")(
-            _norm(cfg, "ln_1")(x), decode=decode, deterministic=deterministic)
+        a, new_cache = CachedAttention(cfg, name="attn")(
+            _norm(cfg, "ln_1")(x), decode=decode, deterministic=deterministic,
+            kv_cache=kv_cache)
         if cfg.parallel_residual:
             m = TransformerMLP(cfg, name="mlp")(_norm(cfg, "ln_2")(x), deterministic)
-            return x + a + m
+            return x + a + m, new_cache
         x = x + a
         m = TransformerMLP(cfg, name="mlp")(_norm(cfg, "ln_2")(x), deterministic)
-        return x + m
+        return x + m, new_cache
 
 
 class _ScanBlock(nn.Module):
+    """One scanned layer. The carry is ``(x, cache, start, layer_idx)``:
+    the STACKED (L-leading) KV cache rides the carry and each layer
+    dynamic-slices its own entry and dynamic-update-slices it back — the
+    carry-DUS pattern XLA keeps in place at any size, unlike scanned
+    cache VARIABLES whose xs/ys accumulator pair double-buffers the
+    quantized cache above ~100 MB (BASELINE.md round-5 capacity
+    section)."""
+
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, decode, deterministic):
+    def __call__(self, carry, decode, deterministic):
+        x, cache, start, li = carry
         cls = TransformerBlock
         if self.config.remat:
             cls = nn.remat(cls, prevent_cse=False, static_argnums=(2, 3))
-        x = cls(self.config, name="block")(x, decode, deterministic)
-        return x, None
+        block = cls(self.config, name="block")
+        if cache is None:
+            x, _ = block(x, decode, deterministic, None)
+            return (x, None, start, li), None
+        kv_slice = {key: jax.lax.dynamic_index_in_dim(val, li, 0,
+                                                      keepdims=False)
+                    for key, val in cache.items()}
+        kv_slice["start"] = start
+        x, new_slice = block(x, decode, deterministic, kv_slice)
+        cache = {key: jax.lax.dynamic_update_slice_in_dim(
+                     cache[key], new_slice[key][None], li, 0)
+                 for key in cache}
+        return (x, cache, start, li + 1), None
+
+
+def kv_cache_spec(cfg: TransformerConfig):
+    """The single source of truth for the KV-cache container: returns
+    ``(cache_dtype, cache_d, kv_packed)`` — the per-layer k/v arrays are
+    (B, KV, cache_d, max_seq_len). Used by CachedAttention (reads/
+    writes), _CacheStore (allocation) and make_layer_kv_cache
+    (ZeRO-Inference allocation) so the layout can never drift apart."""
+    D = cfg.head_dim
+    kv_packed = (cfg.kv_cache_quant and cfg.kv_cache_packed and D % 4 == 0)
+    if kv_packed:
+        return jnp.int32, D // 4, True
+    if cfg.kv_cache_quant:
+        return jnp.int8, D, False
+    return cfg.dtype, D, False
+
+
+def make_layer_kv_cache(cfg: TransformerConfig, batch_size: int) -> dict:
+    """Zeroed SINGLE-LAYER KV cache dict — the explicit functional form
+    of one _CacheStore slice, for callers that stream layers one at a
+    time (ZeRO-Inference) and thread the cache themselves. Add a
+    ``start`` scalar before passing to TransformerBlock."""
+    cache_dtype, cache_d, _ = kv_cache_spec(cfg)
+    KV = cfg.kv_heads
+    cache = {"k": jnp.zeros((batch_size, KV, cache_d, cfg.max_seq_len),
+                            cache_dtype),
+             "v": jnp.zeros((batch_size, KV, cache_d, cfg.max_seq_len),
+                            cache_dtype)}
+    if cfg.kv_cache_quant:
+        sshape = (batch_size, KV, cfg.max_seq_len)
+        cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return cache
+
+
+class _CacheStore(nn.Module):
+    """Owns the STACKED (n_layer-leading) KV-cache arrays as top-level
+    flax variables in the ``cache`` collection. The stack rides the
+    layer scan's CARRY (see _ScanBlock) rather than scanned per-layer
+    variables; this module is only the flax-variable home that keeps the
+    engine-facing contract (prefill/decode with ``mutable=["cache"]``,
+    cache an opaque pytree) unchanged. Call once to READ (returns the
+    value dict + start), again with ``new_values``/``new_index`` to
+    WRITE the post-scan state back."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, batch_size, new_values=None, new_index=None):
+        cfg = self.config
+        L, KV = cfg.n_layer, cfg.kv_heads
+        cache_dtype, cache_d, _ = kv_cache_spec(cfg)
+        shape = (L, batch_size, KV, cache_d, cfg.max_seq_len)
+        ck = self.variable("cache", "k", jnp.zeros, shape, cache_dtype)
+        cv = self.variable("cache", "v", jnp.zeros, shape, cache_dtype)
+        values = {"k": ck.value, "v": cv.value}
+        if cfg.kv_cache_quant:
+            sshape = (L, batch_size, KV, cfg.max_seq_len)
+            cks = self.variable("cache", "k_scale", jnp.zeros, sshape,
+                                jnp.float32)
+            cvs = self.variable("cache", "v_scale", jnp.zeros, sshape,
+                                jnp.float32)
+            values.update(k_scale=cks.value, v_scale=cvs.value)
+        cidx = self.variable("cache", "index",
+                             lambda: jnp.zeros((), jnp.int32))
+        if new_values is not None:
+            ck.value = new_values["k"]
+            cv.value = new_values["v"]
+            if cfg.kv_cache_quant:
+                cks.value = new_values["k_scale"]
+                cvs.value = new_values["v_scale"]
+            cidx.value = new_index
+        return values, cidx.value
 
 
 class TransformerLM(nn.Module):
@@ -484,12 +603,13 @@ class TransformerLM(nn.Module):
             self.embed_ln = _norm(cfg, "embed_ln")
         self.blocks = nn.scan(
             _ScanBlock,
-            variable_axes={"params": 0, "cache": 0},
+            variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
             length=cfg.n_layer,
             in_axes=(nn.broadcast, nn.broadcast),
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(cfg, name="blocks")
+        self.cache_store = _CacheStore(cfg, name="cache_store")
         self.ln_f = _norm(cfg, "ln_f")
         if not cfg.tie_word_embeddings:
             head_cfg = cfg if (cfg.int8_head or not cfg.int8_weights) else \
@@ -499,12 +619,21 @@ class TransformerLM(nn.Module):
 
     def _transform(self, input_ids, positions, decode, deterministic):
         cfg = self.config
+        B, T = input_ids.shape
         x = self.embed_tokens(input_ids)
         if cfg.pos_emb == "learned":
             x = x + self.embed_pos(positions)
         if cfg.embed_layernorm:
             x = self.embed_ln(x)
-        x, _ = self.blocks(x, decode, deterministic)
+        if decode:
+            cache, start = self.cache_store(B)
+            carry = (x, cache, start, jnp.zeros((), jnp.int32))
+            (x, cache, _, _), _ = self.blocks(carry, decode, deterministic)
+            self.cache_store(B, new_values=cache, new_index=start + T)
+        else:
+            carry = (x, None, jnp.zeros((), jnp.int32),
+                     jnp.zeros((), jnp.int32))
+            (x, _, _, _), _ = self.blocks(carry, decode, deterministic)
         x = self.ln_f(x)
         if cfg.tie_word_embeddings:
             return self.embed_tokens.attend(x.astype(jnp.float32))

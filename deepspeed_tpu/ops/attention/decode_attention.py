@@ -8,9 +8,15 @@ the value reduction fused in a single pass. Decode is HBM-bandwidth bound
 (the whole cache is read every step); fusing keeps the (H, S) score matrix
 in VMEM instead of HBM and reads K/V exactly once.
 
-Layout: q (B, H, D); k/v cache (B, KV, S, D) — the model's cache layout
-(per-head (S, D) contiguous: S on sublanes, D on lanes, satisfying the
-Mosaic block-tiling rules). Grouped-query attention maps query head h to
+Layout: q (B, H, D); k/v cache (B, KV, D, S) — the model's cache layout:
+D on SUBLANES, positions on LANES. Positions-minor is deliberate: S is
+always a multiple of 128, so no tile is ever lane-padded (a (S, D=64)
+cache pads every 128-lane tile 2x — measured as the capacity killer in
+the round-5 ladder), and the int8-packed int32 container keeps whole
+positions per word so cache writes stay word-aligned plain
+dynamic-update-slices. The kernel's two dots contract directly against
+this orientation (q·K over D-sublanes, p·V over position-lanes) — no
+transpose anywhere. Grouped-query attention maps query head h to
 kv head h // (H // KV) in the BlockSpec index map. ``lengths`` (B,) masks
 cache slots >= length. Optional ALiBi slopes add the reference's alibi
 bias. Blocks past a sequence's length are dead: ``pl.when`` skips their
@@ -68,10 +74,47 @@ def quantize_kv_rows(x: jax.Array):
     return q, scale
 
 
+def pack_int8_sublanes(x8: jax.Array) -> jax.Array:
+    """Pack int8 (..., R, C) into an int32 container (..., R//4, C):
+    byte ``j`` of word ``(i, c)`` is element ``(4*i + j, c)``.
+
+    Why: Mosaic stores int8 arrays in a (4, 1)-packed tiled layout; when
+    an int8 KV cache rides a ``lax.scan``/while-loop carry, a
+    layout-conversion copy defeats XLA's in-place buffer aliasing and the
+    decode program double-buffers the cache (measured: BASELINE.md
+    round-5 "capacity ladder" section — the 485 MB-over OOM at int8 B=4).
+    int32 carries use the native (8, 128) tiling and alias in place, so
+    the same bytes in an int32 container restore O(cache) memory.
+
+    For the (B, KV, D, S) cache this packs along D (the sublane dim), so
+    each word holds 4 head-dim rows of one position and cache writes stay
+    word-aligned. The byte order equals the TPU's own sublane packing, so
+    inside the kernel ``pltpu.bitcast(words, int8)`` reinterprets the
+    (D//4, block) int32 tile as the (D, block) int8 tile FOR FREE — no
+    shifts, no relayout (verified identical on real v5e and in interpret
+    mode)."""
+    R = x8.shape[-2]
+    assert R % 4 == 0, f"packed dim {R} not a multiple of 4"
+    w = (x8.reshape(*x8.shape[:-2], R // 4, 4, x8.shape[-1])
+         .astype(jnp.int32) & jnp.int32(0xFF))
+    return (w[..., 0, :] | (w[..., 1, :] << 8) | (w[..., 2, :] << 16)
+            | (w[..., 3, :] << 24))
+
+
+def unpack_int8_sublanes(w: jax.Array, dtype=jnp.int8) -> jax.Array:
+    """Inverse of :func:`pack_int8_sublanes` in plain jnp (for the einsum
+    fallback and host-side round trips): (..., R//4, C) -> (..., R, C).
+    Arithmetic right shift sign-extends each byte."""
+    parts = jnp.stack(
+        [((w << (24 - 8 * j)) >> 24) for j in range(4)], axis=-2)
+    return parts.reshape(*w.shape[:-2], w.shape[-2] * 4,
+                         w.shape[-1]).astype(dtype)
+
+
 def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, scale: float, block_s: int,
                    alibi: bool, compute_dtype=None,
-                   k_scale_ref=None, v_scale_ref=None):
+                   k_scale_ref=None, v_scale_ref=None, packed: bool = False):
     # len_ref/slope_ref are scalar-prefetch SMEM arrays: (B,) and (H,).
     # With an int8-quantized cache, k_scale_ref/v_scale_ref carry the
     # per-row (per token, per kv-head) dequantization scales and are
@@ -99,12 +142,20 @@ def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
         # multiplies instead of dequantizing the (block_s, D) blocks.
         q = q_ref[0]                                      # (1, D)
         qb = jnp.broadcast_to(q, (SUBLANES, q.shape[-1]))
-        k = k_ref[0, 0]                                   # (block_s, D)
+        k = k_ref[0, 0]                                   # (D, block_s)
         v = v_ref[0, 0]
         if k_scale_ref is not None:
-            k = k.astype(compute_dtype)
-            v = v.astype(compute_dtype)
-        s = jax.lax.dot_general(qb, k, (((1,), (1,)), ((), ())),
+            if packed:
+                # int32-packed int8 cache: the (D//4, block) int32 tile
+                # IS the (D, block) int8 tile bit-for-bit (sublane byte
+                # order) — bitcast reinterprets it for free. int8
+                # magnitudes are exact in bf16, so the cast is lossless.
+                k = pltpu.bitcast(k, jnp.int8).astype(compute_dtype)
+                v = pltpu.bitcast(v, jnp.int8).astype(compute_dtype)
+            else:
+                k = k.astype(compute_dtype)
+                v = v.astype(compute_dtype)
+        s = jax.lax.dot_general(qb, k, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if k_scale_ref is not None:
             s = s * k_scale_ref[0, 0]                     # (1, block_s) scale
@@ -125,7 +176,7 @@ def _decode_kernel(len_ref, slope_ref, q_ref, k_ref, v_ref, o_ref,
         if v_scale_ref is not None:
             p = p * v_scale_ref[0, 0]                     # (1, block_s) scale
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
@@ -145,8 +196,13 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
     Args:
       q: (B, H, D) current-step queries.
-      k_cache/v_cache: (B, KV, S, D) with H % KV == 0 (GQA). May be int8
-        (quantized KV cache) when ``k_scale``/``v_scale`` are given.
+      k_cache/v_cache: (B, KV, D, S) with H % KV == 0 (GQA) — positions
+        minor (see module docstring: no lane padding, aligned writes).
+        May be int8 (quantized KV cache) when ``k_scale``/``v_scale``
+        are given, or int32 (B, KV, D//4, S) — the
+        :func:`pack_int8_sublanes` container whose carries alias in
+        place through ``lax.scan`` (the in-kernel unpack is a free
+        ``pltpu.bitcast``).
       lengths: (B,) or scalar int32 — valid cache slots per sequence
         (INCLUDING the current token, already written to the cache).
       alibi_slopes: optional (H,) ALiBi slopes.
@@ -158,11 +214,14 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     Returns (B, H, D) in q's dtype.
     """
     B, H, D = q.shape
-    _, KV, S, _ = k_cache.shape
+    _, KV, Dc, S = k_cache.shape
     assert H % KV == 0, f"H={H} not a multiple of KV={KV}"
     assert (k_scale is None) == (v_scale is None), \
         "provide both k_scale and v_scale or neither"
     quantized = k_scale is not None
+    packed = quantized and k_cache.dtype == jnp.int32
+    assert Dc == (D // 4 if packed else D), \
+        f"cache head dim {Dc} vs query head dim {D} (packed={packed})"
     rep = H // KV
     # MXU operands must share a dtype (the kernel no longer upcasts to
     # fp32 — bf16 runs at full MXU rate); harmonize q to the cache dtype
@@ -199,17 +258,14 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         # indices elide the DMA, so bandwidth tracks the live length
         last_live = jnp.maximum(
             (len_ref[b] + block_s - 1) // block_s - 1, 0)
-        return (b, h // rep, jnp.minimum(j, last_live), 0)
-
-    def scale_index(b, h, j, len_ref, slope_ref):
-        last_live = jnp.maximum(
-            (len_ref[b] + block_s - 1) // block_s - 1, 0)
         return (b, h // rep, 0, jnp.minimum(j, last_live))
+
+    scale_index = kv_index
 
     in_specs = [
         pl.BlockSpec((1, 1, D), lambda b, h, j, *_: (b * H + h, 0, 0)),
-        pl.BlockSpec((1, 1, block_s, D), kv_index),
-        pl.BlockSpec((1, 1, block_s, D), kv_index),
+        pl.BlockSpec((1, 1, Dc, block_s), kv_index),
+        pl.BlockSpec((1, 1, Dc, block_s), kv_index),
     ]
     operands = [lengths, slopes, q3, k_cache, v_cache]
     if quantized:
@@ -227,7 +283,8 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            acc_ref, m_ref, l_ref, scale=scale,
                            block_s=block_s, alibi=alibi,
                            compute_dtype=compute_dtype,
-                           k_scale_ref=ks_ref, v_scale_ref=vs_ref)
+                           k_scale_ref=ks_ref, v_scale_ref=vs_ref,
+                           packed=packed)
     else:
         kernel = functools.partial(_decode_kernel, scale=scale,
                                    block_s=block_s, alibi=alibi)

@@ -105,9 +105,10 @@ class TransformerLMAdapter(StreamedModelAdapter):
             deterministic = False
         rngs = {"dropout": rng} if (not deterministic and
                                     self.dropout > 0) else None
-        # TransformerBlock signature: (x, decode, deterministic)
+        # TransformerBlock signature: (x, decode, deterministic, kv_cache)
+        # -> (x, new_kv_cache); the training path carries no cache
         return self._block.apply({"params": layer_params}, x, False,
-                                 deterministic, rngs=rngs)
+                                 deterministic, rngs=rngs)[0]
 
     def head_loss(self, resident, xL, batch):
         from ...models.transformer_lm import _norm

@@ -14,6 +14,12 @@ from deepspeed_tpu.ops.attention.decode_attention import (
 )
 
 
+def _ds(cache):
+    """Tests build caches (B, KV, S, D) for readability; the kernel takes
+    the model's positions-minor (B, KV, D, S) layout."""
+    return cache.transpose(0, 1, 3, 2)
+
+
 def _reference(q, k, v, lengths, slopes=None):
     B, H, D = q.shape
     _, KV, S, _ = k.shape
@@ -41,7 +47,7 @@ def test_matches_reference(B, H, KV, D, S, block):
     k = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.float32)
     lengths = jnp.asarray(rng.integers(1, S + 1, B), jnp.int32)
-    out = decode_attention(q, k, v, lengths, block_s=block)
+    out = decode_attention(q, _ds(k), _ds(v), lengths, block_s=block)
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(_reference(q, k, v, lengths)),
                                atol=1e-4, rtol=1e-4)
@@ -55,7 +61,8 @@ def test_alibi_bias():
     v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
     lengths = jnp.asarray([100, 37], jnp.int32)
     slopes = jnp.asarray(rng.standard_normal(H) * 0.1, jnp.float32)
-    out = decode_attention(q, k, v, lengths, alibi_slopes=slopes, block_s=64)
+    out = decode_attention(q, _ds(k), _ds(v), lengths, alibi_slopes=slopes,
+                           block_s=64)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(_reference(q, k, v, lengths, slopes)),
         atol=1e-4, rtol=1e-4)
@@ -66,7 +73,8 @@ def test_scalar_length_broadcasts():
     q = jnp.asarray(rng.standard_normal((3, 2, 64)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((3, 2, 64, 64)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((3, 2, 64, 64)), jnp.float32)
-    out = decode_attention(q, k, v, jnp.asarray(17, jnp.int32), block_s=64)
+    out = decode_attention(q, _ds(k), _ds(v), jnp.asarray(17, jnp.int32),
+                           block_s=64)
     expect = _reference(q, k, v, jnp.full(3, 17, jnp.int32))
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                atol=1e-4, rtol=1e-4)
@@ -118,7 +126,7 @@ def test_bf16_matches_reference():
     k = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.bfloat16)
     lengths = jnp.asarray([S, S // 3], jnp.int32)
-    out = decode_attention(q, k, v, lengths, block_s=64)
+    out = decode_attention(q, _ds(k), _ds(v), lengths, block_s=64)
     assert out.dtype == jnp.bfloat16
     ref = _reference(q, k, v, lengths)
     np.testing.assert_allclose(np.asarray(out, np.float32),
@@ -134,7 +142,8 @@ def test_mixed_dtype_query_is_harmonized():
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.bfloat16)
-    out = decode_attention(q, k, v, jnp.asarray([S], jnp.int32), block_s=64)
+    out = decode_attention(q, _ds(k), _ds(v), jnp.asarray([S], jnp.int32),
+                           block_s=64)
     assert out.dtype == jnp.float32
     assert bool(jnp.all(jnp.isfinite(out)))
 
@@ -157,8 +166,8 @@ def test_int8_kv_cache_matches_dequantized_reference(B, H, KV, D, S, block):
 
     k8, ks = quantize_kv_rows(k)
     v8, vs = quantize_kv_rows(v)
-    out = decode_attention(q, k8, v8, lengths, k_scale=ks, v_scale=vs,
-                           block_s=block)
+    out = decode_attention(q, _ds(k8), _ds(v8), lengths, k_scale=ks,
+                           v_scale=vs, block_s=block)
     k_deq = k8.astype(jnp.float32) * ks[..., None]
     v_deq = v8.astype(jnp.float32) * vs[..., None]
     ref = _reference(q, k_deq, v_deq, lengths)
@@ -181,8 +190,8 @@ def test_int8_kv_cache_bf16_query():
     lengths = jnp.asarray([97], jnp.int32)
     k8, ks = quantize_kv_rows(k)
     v8, vs = quantize_kv_rows(v)
-    out = decode_attention(q, k8, v8, lengths, k_scale=ks, v_scale=vs,
-                           block_s=64)
+    out = decode_attention(q, _ds(k8), _ds(v8), lengths, k_scale=ks,
+                           v_scale=vs, block_s=64)
     assert out.dtype == jnp.bfloat16
     k_deq = k8.astype(jnp.float32) * ks[..., None]
     v_deq = v8.astype(jnp.float32) * vs[..., None]
@@ -192,11 +201,13 @@ def test_int8_kv_cache_bf16_query():
 
 
 @pytest.mark.parametrize("kernel_mode", ["on", "off"])
-def test_model_int8_kv_cache_generates_same_tokens(kernel_mode):
-    """kv_cache_quant=True end-to-end: the cache leaves are int8 with
-    per-row scales, and greedy generation matches the full-precision
-    cache (tiny model: quantization noise below the argmax margin) on
-    both the fused-kernel and einsum decode paths."""
+@pytest.mark.parametrize("packed", [True, False])
+def test_model_int8_kv_cache_generates_same_tokens(kernel_mode, packed):
+    """kv_cache_quant=True end-to-end: the cache leaves are int8 (or the
+    int32 packed container — the default) with per-row scales, and greedy
+    generation matches the full-precision cache (tiny model: quantization
+    noise below the argmax margin) on both the fused-kernel and einsum
+    decode paths."""
     import deepspeed_tpu as ds
     from deepspeed_tpu.models.transformer_lm import (
         TransformerConfig,
@@ -209,7 +220,8 @@ def test_model_int8_kv_cache_generates_same_tokens(kernel_mode):
         cfg = TransformerConfig(vocab_size=32, max_seq_len=64, n_embd=64,
                                 n_layer=2, n_head=2, dtype=jnp.float32,
                                 decode_kernel=kernel_mode,
-                                kv_cache_quant=quant)
+                                kv_cache_quant=quant,
+                                kv_cache_packed=packed)
         eng = ds.init_inference(TransformerLM(cfg), config={"dtype": "fp32"})
         toks = eng.generate(prompts, max_new_tokens=8)
         return toks, eng
@@ -218,7 +230,8 @@ def test_model_int8_kv_cache_generates_same_tokens(kernel_mode):
     toks_f, _ = gen(False)
     np.testing.assert_array_equal(toks_q, toks_f)
 
-    # the cache really is int8 + scales (half the bytes of bf16)
+    # the cache really is int8 + scales (half the bytes of bf16); packed
+    # mode stores the same bytes 4-per-int32-word with head_dim/4 lanes
     _, cache = eng_q._jit_prefill(eng_q.params, prompts)
     leaves = jax.tree_util.tree_leaves_with_path(cache)
     kv = [lf for p, lf in leaves
@@ -226,5 +239,91 @@ def test_model_int8_kv_cache_generates_same_tokens(kernel_mode):
     scales = [lf for p, lf in leaves
               if any(getattr(x, "key", None) in ("k_scale", "v_scale")
                      for x in p)]
-    assert kv and all(lf.dtype == jnp.int8 for lf in kv)
+    # cache layout is positions-minor (B, KV, D, S); packed mode holds 4
+    # head-dim rows per int32 word
+    want_dtype = jnp.int32 if packed else jnp.int8
+    want_d = (64 // 2) // 4 if packed else 64 // 2  # head_dim=32
+    assert kv and all(lf.dtype == want_dtype and lf.shape[-2] == want_d
+                      and lf.shape[-1] == 64 for lf in kv)
     assert scales and all(lf.dtype == jnp.float32 for lf in scales)
+
+
+def test_pack_int8_sublanes_round_trip():
+    """pack/unpack are exact inverses; byte j of word i is row 4i+j (the
+    TPU sublane byte order, so the kernel's bitcast is a free unpack)."""
+    from deepspeed_tpu.ops.attention.decode_attention import (
+        pack_int8_sublanes,
+        unpack_int8_sublanes,
+    )
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.integers(-127, 128, (2, 3, 8, 64)), jnp.int8)
+    w = pack_int8_sublanes(x)
+    assert w.dtype == jnp.int32 and w.shape == (2, 3, 2, 64)
+    np.testing.assert_array_equal(np.asarray(unpack_int8_sublanes(w)),
+                                  np.asarray(x))
+    # byte 0 of word i is row 4i, sign bits included
+    np.testing.assert_array_equal(
+        np.asarray(w & 0xFF, np.uint8).astype(np.int8),
+        np.asarray(x[..., ::4, :]))
+
+
+@pytest.mark.parametrize("B,H,KV,D,S,block", [
+    (2, 4, 4, 64, 128, 64),     # MHA
+    (2, 8, 2, 64, 256, 128),    # GQA 4x
+])
+def test_packed_int8_kv_cache_matches_unpacked(B, H, KV, D, S, block):
+    """The int32-packed cache path computes bit-identically to the plain
+    int8 cache path (same quantized values, same kernel math)."""
+    from deepspeed_tpu.ops.attention.decode_attention import (
+        pack_int8_sublanes,
+        quantize_kv_rows,
+    )
+
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, KV, S, D)), jnp.float32)
+    lengths = jnp.asarray(rng.integers(1, S + 1, B), jnp.int32)
+    k8, ks = quantize_kv_rows(k)
+    v8, vs = quantize_kv_rows(v)
+    out_s8 = decode_attention(q, _ds(k8), _ds(v8), lengths, k_scale=ks,
+                              v_scale=vs, block_s=block)
+    out_i32 = decode_attention(q, pack_int8_sublanes(_ds(k8)),
+                               pack_int8_sublanes(_ds(v8)),
+                               lengths, k_scale=ks, v_scale=vs,
+                               block_s=block)
+    np.testing.assert_array_equal(np.asarray(out_i32), np.asarray(out_s8))
+
+
+def test_packed_chunked_decode_matches_unpacked():
+    """Multi-token decode (T > 1, the windowed einsum fallback) over a
+    packed cache: prefill at an unaligned length, then a 3-token chunk —
+    logits must match the plain-int8 cache bit for bit (same quantized
+    rows, the fallback unpacks the container)."""
+    import deepspeed_tpu  # noqa: F401  (path setup)
+    from deepspeed_tpu.models.transformer_lm import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    prompts = jnp.asarray(np.arange(7, dtype=np.int32)[None] % 32)
+    chunk = jnp.asarray([[3, 1, 4]], jnp.int32)
+
+    def run(packed):
+        cfg = TransformerConfig(vocab_size=32, max_seq_len=64, n_embd=64,
+                                n_layer=2, n_head=2, dtype=jnp.float32,
+                                decode_kernel="off", kv_cache_quant=True,
+                                kv_cache_packed=packed)
+        m = TransformerLM(cfg)
+        params = m.init({"params": jax.random.PRNGKey(0)}, prompts,
+                        method=m.prefill)["params"]
+        _, vars_ = m.apply({"params": params}, prompts, method=m.prefill,
+                           mutable=["cache"])
+        logits, _ = m.apply(
+            {"params": params, "cache": vars_["cache"]}, chunk,
+            jnp.asarray(prompts.shape[1], jnp.int32), method=m.decode,
+            mutable=["cache"])
+        return np.asarray(logits)
+
+    np.testing.assert_array_equal(run(True), run(False))
