@@ -241,10 +241,18 @@ class InferenceEngine:
                 mutable=["cache"])
             return out, vars_["cache"]
 
-        def decode_fn(params, cache, token, pos):
+        # block_hint (STATIC) right-sizes the decode kernel's block
+        # granule to the generation budget instead of the allocated
+        # capacity — only for models whose decode() accepts it
+        import inspect
+        takes_hint = "block_hint" in inspect.signature(
+            module.decode).parameters if hasattr(module, "decode") else False
+
+        def decode_fn(params, cache, token, pos, block_hint=None):
+            kw = {"block_hint": block_hint} if takes_hint else {}
             out, vars_ = module.apply(
                 {"params": dequant(params), "cache": cache}, token, pos,
-                method=module.decode, mutable=["cache"])
+                method=module.decode, mutable=["cache"], **kw)
             return out, vars_["cache"]
 
         def sample_fn(logits, rng, temperature, top_k, top_p, greedy):
@@ -267,7 +275,7 @@ class InferenceEngine:
             return jnp.where(greedy, jnp.argmax(last, axis=-1), sampled)
 
         def decode_scan_fn(params, cache, token, pos, rng, temperature,
-                           greedy, n_steps, top_k, top_p):
+                           greedy, n_steps, top_k, top_p, block_hint=None):
             """The whole decode loop as ONE compiled program — the TPU
             equivalent of the reference's CUDA-graph capture/replay
             (inference/engine.py:532,551): a single dispatch generates
@@ -275,7 +283,8 @@ class InferenceEngine:
 
             def body(carry, _):
                 cache, token, pos, rng = carry
-                logits, cache = decode_fn(params, cache, token[:, None], pos)
+                logits, cache = decode_fn(params, cache, token[:, None], pos,
+                                          block_hint)
                 rng, sub = jax.random.split(rng)
                 nxt = sample_fn(logits, sub, temperature, top_k, top_p,
                                 greedy).astype(jnp.int32)
@@ -287,11 +296,12 @@ class InferenceEngine:
 
         self._jit_logits = jax.jit(logits_fn)
         self._jit_prefill = jax.jit(prefill_fn)
-        self._jit_decode = jax.jit(decode_fn, donate_argnums=(1,))
+        self._jit_decode = jax.jit(decode_fn, donate_argnums=(1,),
+                                   static_argnums=(4,))
         self._jit_sample = jax.jit(sample_fn, static_argnums=(3, 4))
         self._jit_decode_scan = jax.jit(decode_scan_fn,
                                         donate_argnums=(1,),
-                                        static_argnums=(7, 8, 9))
+                                        static_argnums=(7, 8, 9, 10))
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, *args, **kwargs):
@@ -333,7 +343,8 @@ class InferenceEngine:
 
     __call__ = forward
 
-    def _compile_decode_scan(self, cache_aval, batch, n_steps, top_k, top_p):
+    def _compile_decode_scan(self, cache_aval, batch, n_steps, top_k, top_p,
+                             block_hint=None):
         """AOT-compile the whole-decode program from avals only (no cache
         buffer live), caching the executable per signature. Returns None
         when AOT lowering is unavailable so generate() falls back to the
@@ -347,7 +358,7 @@ class InferenceEngine:
         leaves = jax.tree_util.tree_leaves(cache_aval)
         key = (jax.tree_util.tree_structure(cache_aval),
                tuple((l.shape, str(l.dtype)) for l in leaves),
-               batch, n_steps, top_k, top_p)
+               batch, n_steps, top_k, top_p, block_hint)
         if key in self._decode_scan_execs:
             return self._decode_scan_execs[key]
         try:
@@ -368,7 +379,7 @@ class InferenceEngine:
                                      sharding=rep),
                 jax.ShapeDtypeStruct((), jnp.float32, sharding=rep),
                 jax.ShapeDtypeStruct((), jnp.bool_, sharding=rep),
-                n_steps, top_k, top_p)
+                n_steps, top_k, top_p, block_hint)
             compiled = lowered.compile()
         except Exception as e:  # noqa: BLE001 — fall back to plain jit
             # do NOT cache the failure: a transient remote-compile outage
@@ -430,6 +441,17 @@ class InferenceEngine:
                 f"prompt({T}) + max_new_tokens({max_new_tokens}) exceeds the "
                 f"allocated KV-cache capacity({capacity})")
 
+        # block_hint stays None: an A/B that derived the block from the
+        # generation budget (preferred_block_for(T + max_new_tokens), so
+        # live 1536 in an 8k cache took the 1024 block) measured EVERY
+        # arm 5-15% slower — decode at these shapes is grid-overhead
+        # bound, not dead-row bound (the index-map clamp already elides
+        # dead-block DMA), so fewer, larger grid steps win even when the
+        # last live block is mostly dead (BASELINE.md round-5 KV e2e
+        # section). The plumbing stays for callers with measured wins at
+        # their own shapes (module.decode(block_hint=...)).
+        block_hint = None
+
         decode_exec = None
         if eos_token_id is None:
             # whole-loop compile (CUDA-graph analog): ONE dispatch for the
@@ -452,7 +474,7 @@ class InferenceEngine:
             # kv_capacity_results.json boundary finding). Donation is part
             # of the lowering, so the dispatch itself aliases as usual.
             decode_exec = self._compile_decode_scan(
-                cache_aval, B, bucket, int(top_k), float(top_p))
+                cache_aval, B, bucket, int(top_k), float(top_p), block_hint)
 
         logits, cache = self._jit_prefill(self.params, input_ids)
         rng = jax.random.PRNGKey(seed)
@@ -479,7 +501,7 @@ class InferenceEngine:
                     rest = None
             if rest is None:
                 _, rest = self._jit_decode_scan(
-                    *args, bucket, int(top_k), float(top_p))
+                    *args, bucket, int(top_k), float(top_p), block_hint)
             toks = np.concatenate([np.asarray(token)[:, None],
                                    np.asarray(rest)[:, :n_steps]], axis=1)
         else:
@@ -493,7 +515,7 @@ class InferenceEngine:
                     break
                 logits, cache = self._jit_decode(
                     self.params, cache, token[:, None],
-                    jnp.asarray(pos, jnp.int32))
+                    jnp.asarray(pos, jnp.int32), block_hint)
                 rng, sub = jax.random.split(rng)
                 token = self._jit_sample(
                     logits, sub, jnp.asarray(temperature, jnp.float32),

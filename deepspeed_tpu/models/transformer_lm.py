@@ -263,7 +263,8 @@ class CachedAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, decode: Union[bool, str] = False,
-                 deterministic: bool = True, kv_cache=None):
+                 deterministic: bool = True, kv_cache=None,
+                 block_hint=None):
         cfg = self.config
         B, T, C = x.shape
         H, KV, D = cfg.n_head, cfg.kv_heads, cfg.head_dim
@@ -346,10 +347,15 @@ class CachedAttention(nn.Module):
                 scales = dict(k_scale=new_cache["k_scale"],
                               v_scale=new_cache["v_scale"]) \
                     if cfg.kv_cache_quant else {}
+                # block_hint (static, from the caller's known generation
+                # budget) shrinks the block granule to the LIVE length
+                # instead of the allocated capacity — cache reads are
+                # block-granular, so this is pure saved bandwidth
                 y = decode_attention(
                     q[:, 0].astype(cfg.dtype), new_cache["k"],
                     new_cache["v"], start + 1, alibi_slopes=slopes,
-                    block_s=pick_block_s(cfg.max_seq_len), **scales)
+                    block_s=pick_block_s(cfg.max_seq_len,
+                                         preferred=block_hint), **scales)
                 y = y.astype(cfg.dtype).reshape(B, 1, H * D)
                 return o_proj(y), new_cache
             if not fresh:
@@ -467,11 +473,12 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, decode: Union[bool, str] = False,
-                 deterministic: bool = True, kv_cache=None):
+                 deterministic: bool = True, kv_cache=None,
+                 block_hint=None):
         cfg = self.config
         a, new_cache = CachedAttention(cfg, name="attn")(
             _norm(cfg, "ln_1")(x), decode=decode, deterministic=deterministic,
-            kv_cache=kv_cache)
+            kv_cache=kv_cache, block_hint=block_hint)
         if cfg.parallel_residual:
             m = TransformerMLP(cfg, name="mlp")(_norm(cfg, "ln_2")(x), deterministic)
             return x + a + m, new_cache
@@ -492,20 +499,21 @@ class _ScanBlock(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, carry, decode, deterministic):
+    def __call__(self, carry, decode, deterministic, block_hint):
         x, cache, start, li = carry
         cls = TransformerBlock
         if self.config.remat:
-            cls = nn.remat(cls, prevent_cse=False, static_argnums=(2, 3))
+            cls = nn.remat(cls, prevent_cse=False,
+                           static_argnums=(2, 3, 5))
         block = cls(self.config, name="block")
         if cache is None:
-            x, _ = block(x, decode, deterministic, None)
+            x, _ = block(x, decode, deterministic, None, block_hint)
             return (x, None, start, li), None
         kv_slice = {key: jax.lax.dynamic_index_in_dim(val, li, 0,
                                                       keepdims=False)
                     for key, val in cache.items()}
         kv_slice["start"] = start
-        x, new_slice = block(x, decode, deterministic, kv_slice)
+        x, new_slice = block(x, decode, deterministic, kv_slice, block_hint)
         cache = {key: jax.lax.dynamic_update_slice_in_dim(
                      cache[key], new_slice[key][None], li, 0)
                  for key in cache}
@@ -606,7 +614,7 @@ class TransformerLM(nn.Module):
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
             length=cfg.n_layer,
-            in_axes=(nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(cfg, name="blocks")
         self.cache_store = _CacheStore(cfg, name="cache_store")
@@ -617,7 +625,8 @@ class TransformerLM(nn.Module):
             self.lm_head = _dense(head_cfg, cfg.vocab_size, use_bias=False,
                                   dtype=jnp.float32, name="lm_head")
 
-    def _transform(self, input_ids, positions, decode, deterministic):
+    def _transform(self, input_ids, positions, decode, deterministic,
+                   block_hint=None):
         cfg = self.config
         B, T = input_ids.shape
         x = self.embed_tokens(input_ids)
@@ -628,12 +637,14 @@ class TransformerLM(nn.Module):
         if decode:
             cache, start = self.cache_store(B)
             carry = (x, cache, start, jnp.zeros((), jnp.int32))
-            (x, cache, _, _), _ = self.blocks(carry, decode, deterministic)
+            (x, cache, _, _), _ = self.blocks(carry, decode, deterministic,
+                                              block_hint)
             self.cache_store(B, new_values=cache, new_index=start + T)
         else:
             carry = (x, None, jnp.zeros((), jnp.int32),
                      jnp.zeros((), jnp.int32))
-            (x, _, _, _), _ = self.blocks(carry, decode, deterministic)
+            (x, _, _, _), _ = self.blocks(carry, decode, deterministic,
+                                          block_hint)
         x = self.ln_f(x)
         if cfg.tie_word_embeddings:
             return self.embed_tokens.attend(x.astype(jnp.float32))
@@ -654,12 +665,17 @@ class TransformerLM(nn.Module):
         pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
         return self._transform(input_ids, pos, "prefill", True)
 
-    def decode(self, input_ids, start_pos):
+    def decode(self, input_ids, start_pos, block_hint=None):
         """One (or few) token step against the cache; ``start_pos`` is the
-        current cache length (B-uniform). Call with ``mutable=["cache"]``."""
+        current cache length (B-uniform). Call with ``mutable=["cache"]``.
+        ``block_hint`` (STATIC int) overrides the fused kernel's block
+        granule — an explicit expert option; engine.generate keeps the
+        allocation-based default after a budget-derived hint measured
+        net-negative (grid overhead dominates dead-row reads;
+        BASELINE.md round-5 KV e2e section)."""
         B, T = input_ids.shape
         pos = start_pos + jnp.broadcast_to(jnp.arange(T)[None], (B, T))
-        return self._transform(input_ids, pos, True, True)
+        return self._transform(input_ids, pos, True, True, block_hint)
 
     def __call__(self, batch, deterministic: bool = False):
         input_ids = batch["input_ids"]
