@@ -241,18 +241,10 @@ class InferenceEngine:
                 mutable=["cache"])
             return out, vars_["cache"]
 
-        # block_hint (STATIC) right-sizes the decode kernel's block
-        # granule to the generation budget instead of the allocated
-        # capacity — only for models whose decode() accepts it
-        import inspect
-        takes_hint = "block_hint" in inspect.signature(
-            module.decode).parameters if hasattr(module, "decode") else False
-
-        def decode_fn(params, cache, token, pos, block_hint=None):
-            kw = {"block_hint": block_hint} if takes_hint else {}
+        def decode_fn(params, cache, token, pos):
             out, vars_ = module.apply(
                 {"params": dequant(params), "cache": cache}, token, pos,
-                method=module.decode, mutable=["cache"], **kw)
+                method=module.decode, mutable=["cache"])
             return out, vars_["cache"]
 
         def sample_fn(logits, rng, temperature, top_k, top_p, greedy):
@@ -275,7 +267,7 @@ class InferenceEngine:
             return jnp.where(greedy, jnp.argmax(last, axis=-1), sampled)
 
         def decode_scan_fn(params, cache, token, pos, rng, temperature,
-                           greedy, n_steps, top_k, top_p, block_hint=None):
+                           greedy, n_steps, top_k, top_p):
             """The whole decode loop as ONE compiled program — the TPU
             equivalent of the reference's CUDA-graph capture/replay
             (inference/engine.py:532,551): a single dispatch generates
@@ -283,8 +275,7 @@ class InferenceEngine:
 
             def body(carry, _):
                 cache, token, pos, rng = carry
-                logits, cache = decode_fn(params, cache, token[:, None], pos,
-                                          block_hint)
+                logits, cache = decode_fn(params, cache, token[:, None], pos)
                 rng, sub = jax.random.split(rng)
                 nxt = sample_fn(logits, sub, temperature, top_k, top_p,
                                 greedy).astype(jnp.int32)
@@ -296,12 +287,11 @@ class InferenceEngine:
 
         self._jit_logits = jax.jit(logits_fn)
         self._jit_prefill = jax.jit(prefill_fn)
-        self._jit_decode = jax.jit(decode_fn, donate_argnums=(1,),
-                                   static_argnums=(4,))
+        self._jit_decode = jax.jit(decode_fn, donate_argnums=(1,))
         self._jit_sample = jax.jit(sample_fn, static_argnums=(3, 4))
         self._jit_decode_scan = jax.jit(decode_scan_fn,
                                         donate_argnums=(1,),
-                                        static_argnums=(7, 8, 9, 10))
+                                        static_argnums=(7, 8, 9))
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, *args, **kwargs):
@@ -343,8 +333,7 @@ class InferenceEngine:
 
     __call__ = forward
 
-    def _compile_decode_scan(self, cache_aval, batch, n_steps, top_k, top_p,
-                             block_hint=None):
+    def _compile_decode_scan(self, cache_aval, batch, n_steps, top_k, top_p):
         """AOT-compile the whole-decode program from avals only (no cache
         buffer live), caching the executable per signature. Returns None
         when AOT lowering is unavailable so generate() falls back to the
@@ -358,7 +347,7 @@ class InferenceEngine:
         leaves = jax.tree_util.tree_leaves(cache_aval)
         key = (jax.tree_util.tree_structure(cache_aval),
                tuple((l.shape, str(l.dtype)) for l in leaves),
-               batch, n_steps, top_k, top_p, block_hint)
+               batch, n_steps, top_k, top_p)
         if key in self._decode_scan_execs:
             return self._decode_scan_execs[key]
         try:
@@ -379,7 +368,7 @@ class InferenceEngine:
                                      sharding=rep),
                 jax.ShapeDtypeStruct((), jnp.float32, sharding=rep),
                 jax.ShapeDtypeStruct((), jnp.bool_, sharding=rep),
-                n_steps, top_k, top_p, block_hint)
+                n_steps, top_k, top_p)
             compiled = lowered.compile()
         except Exception as e:  # noqa: BLE001 — fall back to plain jit
             # do NOT cache the failure: a transient remote-compile outage
@@ -441,17 +430,16 @@ class InferenceEngine:
                 f"prompt({T}) + max_new_tokens({max_new_tokens}) exceeds the "
                 f"allocated KV-cache capacity({capacity})")
 
-        # block_hint stays None: an A/B that derived the block from the
-        # generation budget (preferred_block_for(T + max_new_tokens), so
-        # live 1536 in an 8k cache took the 1024 block) measured EVERY
-        # arm 5-15% slower — decode at these shapes is grid-overhead
-        # bound, not dead-row bound (the index-map clamp already elides
-        # dead-block DMA), so fewer, larger grid steps win even when the
-        # last live block is mostly dead (BASELINE.md round-5 KV e2e
-        # section). The plumbing stays for callers with measured wins at
-        # their own shapes (module.decode(block_hint=...)).
-        block_hint = None
-
+        # NOTE generate() deliberately does NOT pass a decode block hint:
+        # an A/B that derived the block from the generation budget
+        # (preferred_block_for(T + max_new_tokens), so live 1536 in an 8k
+        # cache took the 1024 block) measured EVERY arm 5-15% slower —
+        # decode at these shapes is grid-overhead bound, not dead-row
+        # bound (the index-map clamp already elides dead-block DMA), so
+        # fewer, larger grid steps win even when the last live block is
+        # mostly dead (BASELINE.md round-5 KV e2e section). Callers with
+        # measured wins at their own shapes can drive
+        # module.decode(block_hint=...) directly.
         decode_exec = None
         if eos_token_id is None:
             # whole-loop compile (CUDA-graph analog): ONE dispatch for the
@@ -474,7 +462,7 @@ class InferenceEngine:
             # kv_capacity_results.json boundary finding). Donation is part
             # of the lowering, so the dispatch itself aliases as usual.
             decode_exec = self._compile_decode_scan(
-                cache_aval, B, bucket, int(top_k), float(top_p), block_hint)
+                cache_aval, B, bucket, int(top_k), float(top_p))
 
         logits, cache = self._jit_prefill(self.params, input_ids)
         rng = jax.random.PRNGKey(seed)
@@ -501,7 +489,7 @@ class InferenceEngine:
                     rest = None
             if rest is None:
                 _, rest = self._jit_decode_scan(
-                    *args, bucket, int(top_k), float(top_p), block_hint)
+                    *args, bucket, int(top_k), float(top_p))
             toks = np.concatenate([np.asarray(token)[:, None],
                                    np.asarray(rest)[:, :n_steps]], axis=1)
         else:
@@ -515,7 +503,7 @@ class InferenceEngine:
                     break
                 logits, cache = self._jit_decode(
                     self.params, cache, token[:, None],
-                    jnp.asarray(pos, jnp.int32), block_hint)
+                    jnp.asarray(pos, jnp.int32))
                 rng, sub = jax.random.split(rng)
                 token = self._jit_sample(
                     logits, sub, jnp.asarray(temperature, jnp.float32),
