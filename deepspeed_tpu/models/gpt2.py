@@ -71,6 +71,13 @@ class GPT2Config:
     # explicit option and parity-tested; does not compose with model
     # parallelism (the Pallas call is not GSPMD-partitionable)
     fused_ln_linear: Any = "auto"
+    # streaming cross-entropy: >0 computes the LM loss in T-chunks of
+    # this size without materializing the (B, T, V) logits tensor
+    # (ops/transformer/chunked_xent.py). Measured ~free at the flagship
+    # (-0.3%) and lets previously-OOM configs compile (350M mbs16, 774M
+    # dots_plain) — but did NOT unlock a better operating point at
+    # either size (BASELINE.md 774M section). 0 = dense loss.
+    loss_chunk: int = 0
 
 
 # sizes for the standard family
@@ -375,27 +382,41 @@ class GPT2LMHeadModel(nn.Module):
         self.ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                                  name="ln_f")
 
-    def logits(self, input_ids, deterministic: bool = True):
-        cfg = self.config
+    def hidden(self, input_ids, deterministic: bool = True):
+        """Final hidden states (B, T, C) before the tied-head projection."""
         B, T = input_ids.shape
         pos = jnp.arange(T)[None, :]
         x = self.wte(input_ids) + self.wpe(pos)
         # nn.scan carries (x,) through the stacked blocks
         x, _ = self.blocks(x, deterministic)
-        x = self.ln_f(x)
+        return self.ln_f(x)
+
+    def logits(self, input_ids, deterministic: bool = True):
+        x = self.hidden(input_ids, deterministic)
         # tied head: project onto embedding matrix
-        logits = self.wte.attend(x.astype(jnp.float32))
-        return logits
+        return self.wte.attend(x.astype(jnp.float32))
 
     def __call__(self, batch, deterministic: bool = False):
+        cfg = self.config
         input_ids = batch["input_ids"]
         labels = batch.get("labels", input_ids) if hasattr(batch, "get") else input_ids
-        logits = self.logits(input_ids, deterministic)
-        # causal shift: predict token t+1
-        logits = logits[:, :-1]
         targets = labels[:, 1:]
         mask = (targets >= 0).astype(jnp.float32)  # -100/-1 = ignore
         targets = jnp.maximum(targets, 0)
+        if cfg.loss_chunk:
+            # streaming loss: never materialize the (B, T, V) logits.
+            # The projection runs in cfg.dtype, exactly like Embed.attend
+            # (which promotes both operands to the module dtype).
+            from ..ops.transformer.chunked_xent import chunked_softmax_xent
+
+            x = self.hidden(input_ids, deterministic)[:, :-1]
+            nll_sum = chunked_softmax_xent(
+                x, self.wte.embedding, targets, mask, cfg.loss_chunk,
+                compute_dtype=cfg.dtype)
+            return nll_sum / jnp.maximum(mask.sum(), 1.0)
+        logits = self.logits(input_ids, deterministic)
+        # causal shift: predict token t+1
+        logits = logits[:, :-1]
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -88,6 +88,9 @@ class TransformerConfig:
     int8_head: bool = False             # quantize lm_head too (off: the vocab
     # projection — the largest single accuracy lever — stays full precision,
     # matching the ZeRO-Inference streamed tier and reference practice)
+    loss_chunk: int = 0                 # streaming cross-entropy: >0 computes
+    # the LM loss in T-chunks of this size without materializing the
+    # (B, T, V) logits (ops/transformer/chunked_xent.py); 0 = dense loss
 
     @property
     def head_dim(self) -> int:
@@ -626,7 +629,7 @@ class TransformerLM(nn.Module):
                                   dtype=jnp.float32, name="lm_head")
 
     def _transform(self, input_ids, positions, decode, deterministic,
-                   block_hint=None):
+                   block_hint=None, head=True):
         cfg = self.config
         B, T = input_ids.shape
         x = self.embed_tokens(input_ids)
@@ -646,6 +649,8 @@ class TransformerLM(nn.Module):
             (x, _, _, _), _ = self.blocks(carry, decode, deterministic,
                                           block_hint)
         x = self.ln_f(x)
+        if not head:
+            return x  # pre-projection hidden states (streaming loss path)
         if cfg.tie_word_embeddings:
             return self.embed_tokens.attend(x.astype(jnp.float32))
         return self.lm_head(x.astype(jnp.float32))
@@ -678,14 +683,42 @@ class TransformerLM(nn.Module):
         return self._transform(input_ids, pos, True, True, block_hint)
 
     def __call__(self, batch, deterministic: bool = False):
+        cfg = self.config
         input_ids = batch["input_ids"]
         labels = batch.get("labels", input_ids) if hasattr(batch, "get") \
             else input_ids
-        logits = self.logits(input_ids, deterministic)
-        logits = logits[:, :-1]
         targets = labels[:, 1:]
         mask = (targets >= 0).astype(jnp.float32)
         targets = jnp.maximum(targets, 0)
+        if cfg.loss_chunk:
+            # streaming loss: never materialize the (B, T, V) logits
+            from ..ops.transformer.chunked_xent import chunked_softmax_xent
+
+            if cfg.int8_weights and cfg.int8_head:
+                raise ValueError(
+                    "loss_chunk does not compose with an int8-quantized "
+                    "lm_head (QuantDense stores an int8 kernel + scale; "
+                    "the streaming loss reads a plain kernel). Serve "
+                    "int8 with the dense loss, or keep the head fp32.")
+            B, T = input_ids.shape
+            pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+            x = self._transform(input_ids, pos, False, deterministic,
+                                head=False)[:, :-1]
+            if cfg.tie_word_embeddings:
+                # Embed.attend promotes both operands to cfg.dtype
+                w, cd = self.embed_tokens.embedding, cfg.dtype
+            else:
+                if self.is_initializing():
+                    # create the head's params (the streaming path reads
+                    # the kernel without calling the module)
+                    self.lm_head(jnp.zeros((1, x.shape[-1]), jnp.float32))
+                w = self.lm_head.variables["params"]["kernel"].T
+                cd = jnp.float32  # the lm_head Dense computes in fp32
+            nll_sum = chunked_softmax_xent(
+                x, w, targets, mask, cfg.loss_chunk, compute_dtype=cd)
+            return nll_sum / jnp.maximum(mask.sum(), 1.0)
+        logits = self.logits(input_ids, deterministic)
+        logits = logits[:, :-1]
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
