@@ -1,11 +1,12 @@
 """Servable capacity at long context: bf16 vs int8 KV cache — measured.
 
-The KV cache dominates serving memory at long context (GPT-2 350M-class
-at S=16384: ~1.6 GB per sequence in bf16, 24 layers of (16, 16384, 64)
-K+V — vs ~0.7 GB of weights). ``kv_cache_quant=True`` halves it. This
-bench walks a batch-size ladder on the real chip and records the
-largest batch each cache dtype can actually serve (allocate full cache,
-prefill, decode tokens) at max_seq_len=16384.
+The KV cache dominates serving memory at long context (GPT-2 350M-class:
+~100 KB per position per sequence in bf16 — ~1.6 GB/sequence at 16k,
+~3.2 GB at 32k — vs ~0.7 GB of weights). ``kv_cache_quant=True`` halves
+it. This bench walks a batch-size ladder on the real chip and records
+the largest batch each cache dtype can actually serve (allocate full
+cache, prefill, decode tokens) at ``max_seq_len = KV_CAPACITY_SEQ``
+(default 16384; 32768 writes the suffixed artifact).
 
 Each trial runs in its OWN subprocess: earlier trials' device buffers
 must not change later trials' headroom. The engine AOT-compiles the
@@ -13,7 +14,7 @@ decode program before prefill buffers go live (inference/engine.py
 ``_compile_decode_scan``), so the compile-time HBM check is not
 inflated by transient double-residency at the prefill→decode boundary.
 
-Run ON the real chip: python benchmarks/kv_capacity_bench.py
+Run ON the real chip: [KV_CAPACITY_SEQ=32768] python benchmarks/kv_capacity_bench.py
 """
 
 from __future__ import annotations
@@ -23,7 +24,8 @@ import os
 import subprocess
 import sys
 
-SEQ = 16384
+SEQ = int(os.environ.get("KV_CAPACITY_SEQ", 16384))  # 32768 for the
+# long-context row (writes kv_capacity_results_32k.json)
 PROMPT = 64
 NEW_TOKENS = 8
 
@@ -91,23 +93,28 @@ def try_batch(B: int, quant: bool, packed: bool = True) -> bool:
 
 
 def main():
+    suffix = "" if SEQ == 16384 else f"_{SEQ // 1024}k"
     out_path = os.path.join(os.path.dirname(__file__),
-                            "kv_capacity_results.json")
+                            f"kv_capacity_results{suffix}.json")
     result = {"seq": SEQ, "model": "gpt2-350m-class (24L, 1024d, 16h)",
               "ladder": {}, "max_batch": {}}
-    # ~1.6 GB/sequence bf16 KV, ~0.9 GB int8 (cache + scales); ladders
-    # run past the expected boundary so a rung is never reported as the
-    # maximum merely because the ladder ended there. Arms:
+    # ~100 KB/position/sequence bf16 KV, ~55 KB int8 (cache + scales);
+    # ladders run past the expected boundary so a rung is never reported
+    # as the maximum merely because the ladder ended there (gap-walk +
+    # climb logic below closes any remainder). Arms:
     #   bf16     — full-precision cache
-    #   int8_s8  — plain-int8 layout: Mosaic's (4,1)-packed tiling defeats
-    #              the decode loop's in-place carry aliasing, so the
-    #              program double-buffers the cache (the round-5 negative)
-    #   int8     — the kv_cache_packed int32 container (default): same
-    #              bytes, natively-tiled carries that alias in place
+    #   int8_s8  — plain-int8 layout (the round-5 double-buffering
+    #              negative; fixed by the carry-DUS scan, kept for A/B)
+    #   int8     — the kv_cache_packed int32 container (default)
+    scale = 16384 / SEQ  # halve the rungs when the cache doubles
+    rung = lambda b: max(1, int(b * scale))  # noqa: E731
     for quant, packed, label, ladder in (
-            (False, True, "bf16", (3, 4, 5, 6, 7, 8, 9)),
-            (True, False, "int8_s8", (4, 6, 8, 10, 12, 14, 16)),
-            (True, True, "int8", (4, 6, 8, 10, 12, 13, 14, 15, 16, 18))):
+            (False, True, "bf16", tuple(dict.fromkeys(
+                rung(b) for b in (3, 4, 5, 6, 7, 8, 9)))),
+            (True, False, "int8_s8", tuple(dict.fromkeys(
+                rung(b) for b in (4, 6, 8, 10, 12, 14, 16)))),
+            (True, True, "int8", tuple(dict.fromkeys(
+                rung(b) for b in (4, 6, 8, 10, 12, 13, 14, 15, 16, 18))))):
         rows = {}
         best, first_fail = 0, None
         for B in ladder:
