@@ -74,6 +74,7 @@ class InferenceEngine:
         self._jit_logits = None
         self._jit_prefill = None
         self._jit_decode = None
+        self._jit_prefill_gen = None
         self._jit_decode_scan = None
         self._jit_sample = None
         self._decode_scan_execs = {}  # aval-keyed AOT decode executables
@@ -241,6 +242,17 @@ class InferenceEngine:
                 mutable=["cache"])
             return out, vars_["cache"]
 
+        # generation-only prefill: last-position logits (the full
+        # (B, T, V) fp32 prompt logits are the largest prefill buffer
+        # and bound the servable batch at long context — BASELINE.md)
+        prefill_gen = getattr(module, "prefill_last", None)
+
+        def prefill_last_fn(params, input_ids):
+            out, vars_ = module.apply(
+                {"params": dequant(params)}, input_ids,
+                method=prefill_gen, mutable=["cache"])
+            return out, vars_["cache"]
+
         def decode_fn(params, cache, token, pos):
             out, vars_ = module.apply(
                 {"params": dequant(params), "cache": cache}, token, pos,
@@ -287,6 +299,8 @@ class InferenceEngine:
 
         self._jit_logits = jax.jit(logits_fn)
         self._jit_prefill = jax.jit(prefill_fn)
+        self._jit_prefill_gen = jax.jit(prefill_last_fn) \
+            if prefill_gen is not None else self._jit_prefill
         self._jit_decode = jax.jit(decode_fn, donate_argnums=(1,))
         self._jit_sample = jax.jit(sample_fn, static_argnums=(3, 4))
         self._jit_decode_scan = jax.jit(decode_scan_fn,
@@ -418,7 +432,7 @@ class InferenceEngine:
         # authoritative even when the model config lacks max_seq_len.
         # Steps past capacity would write out of bounds (silently clamped
         # by JAX today, but fragile); fail loudly.
-        _, cache_aval = jax.eval_shape(self._jit_prefill, self.params,
+        _, cache_aval = jax.eval_shape(self._jit_prefill_gen, self.params,
                                        input_ids)
         cache_cap = max((x.shape[-1]
                          for x in jax.tree_util.tree_leaves(cache_aval)
@@ -464,7 +478,7 @@ class InferenceEngine:
             decode_exec = self._compile_decode_scan(
                 cache_aval, B, bucket, int(top_k), float(top_p))
 
-        logits, cache = self._jit_prefill(self.params, input_ids)
+        logits, cache = self._jit_prefill_gen(self.params, input_ids)
         rng = jax.random.PRNGKey(seed)
         rng, sub = jax.random.split(rng)
         token = self._jit_sample(logits, sub, jnp.asarray(temperature, jnp.float32),

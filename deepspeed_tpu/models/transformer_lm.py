@@ -651,7 +651,12 @@ class TransformerLM(nn.Module):
         x = self.ln_f(x)
         if not head:
             return x  # pre-projection hidden states (streaming loss path)
-        if cfg.tie_word_embeddings:
+        return self._project_head(x)
+
+    def _project_head(self, x):
+        """The ONE vocabulary-projection path (scoring, generation
+        prefill and decode all route here)."""
+        if self.config.tie_word_embeddings:
             return self.embed_tokens.attend(x.astype(jnp.float32))
         return self.lm_head(x.astype(jnp.float32))
 
@@ -669,6 +674,19 @@ class TransformerLM(nn.Module):
         B, T = input_ids.shape
         pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
         return self._transform(input_ids, pos, "prefill", True)
+
+    def prefill_last(self, input_ids):
+        """Prefill variant for GENERATION: fills the cache but projects
+        only the LAST position onto the vocabulary, returning (B, 1, V)
+        logits. Sampling uses only the last position, and the full
+        (B, T, V) fp32 logits are the largest prefill allocation
+        (~0.8 GB at B=8/T=512/V=50k — measured as the binding constraint
+        on the 32k serving row, BASELINE.md); scoring callers keep
+        ``prefill``."""
+        B, T = input_ids.shape
+        pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        x = self._transform(input_ids, pos, "prefill", True, head=False)
+        return self._project_head(x[:, -1:])
 
     def decode(self, input_ids, start_pos, block_hint=None):
         """One (or few) token step against the cache; ``start_pos`` is the

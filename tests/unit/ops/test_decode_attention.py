@@ -339,6 +339,38 @@ def test_block_hint_changes_block_not_tokens():
     assert toks_auto.shape == (1, prompts.shape[1] + 8)
 
 
+def test_prefill_last_matches_full_prefill():
+    """The generation-only prefill (last-position logits, the engine's
+    generate() path) must produce bitwise the same cache as the full
+    prefill and logits equal to its last row — sampling sees no
+    difference, only the (B, T, V) prompt-logits allocation disappears."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer_lm import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    prompts = jnp.asarray(np.arange(7, dtype=np.int32)[None] % 32)
+    cfg = TransformerConfig(vocab_size=32, max_seq_len=64, n_embd=64,
+                            n_layer=2, n_head=2, dtype=jnp.float32,
+                            kv_cache_quant=True)
+    eng = ds.init_inference(TransformerLM(cfg), config={"dtype": "fp32"})
+    eng.generate(np.asarray(prompts), max_new_tokens=2)  # init params
+    m, p = TransformerLM(cfg), eng._params_host
+    full, v1 = m.apply({"params": p}, prompts, method=m.prefill,
+                       mutable=["cache"])
+    last, v2 = m.apply({"params": p}, prompts, method=m.prefill_last,
+                       mutable=["cache"])
+    assert last.shape == (1, 1, 32)
+    np.testing.assert_allclose(np.asarray(last[:, 0]),
+                               np.asarray(full[:, -1]), rtol=1e-6)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(v1["cache"]),
+            jax.tree_util.tree_leaves_with_path(v2["cache"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(pa))
+
+
 def test_packed_chunked_decode_matches_unpacked():
     """Multi-token decode (T > 1, the windowed einsum fallback) over a
     packed cache: prefill at an unaligned length, then a 3-token chunk —
