@@ -5,6 +5,12 @@ sweeps; VERDICT r2 next-#1).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
 
+``python bench.py serving`` instead runs the Poisson-arrival serving row:
+continuous batching (deepspeed_tpu/serving/) vs the batch-synchronous
+"gang" discipline ``generate()`` imposes, SAME engine/kernels/slot count,
+only the admission policy differs. Reports req/s and p50/p99 TTFT for
+both arms; ``vs_baseline`` = continuous req/s over gang req/s.
+
 ``vs_baseline`` compares achieved model TFLOPS against the reference's
 headline single-device number: 64 TFLOPS/GPU for BERT-Large pretraining
 with DeepSpeed's fused kernels on V100-32GB (BASELINE.md row 1, reference
@@ -132,14 +138,116 @@ def main():
     }))
 
 
+def serving_main():
+    """Poisson-arrival serving row: continuous vs gang scheduling."""
+    import jax
+    import jax.numpy as jnp
+
+    _enable_persistent_cache()
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer_lm import (TransformerConfig,
+                                                     TransformerLM)
+    from deepspeed_tpu.serving import ServingEngine
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:  # keep the row runnable for local validation
+        cfg = TransformerConfig(vocab_size=512, max_seq_len=256, n_embd=64,
+                                n_layer=2, n_head=4, dtype=jnp.float32)
+        n_req, slots, rate = 32, 4, 200.0
+        len_lo, len_hi, gen_lo, gen_hi = 8, 48, 4, 48
+    else:
+        # GPT-2 124M-ish decode under a bursty open-loop arrival process
+        cfg = TransformerConfig(vocab_size=50257, max_seq_len=1024,
+                                n_embd=768, n_layer=12, n_head=12,
+                                dtype=jnp.bfloat16)
+        n_req, slots, rate = 64, 8, 48.0
+        len_lo, len_hi, gen_lo, gen_hi = 32, 256, 16, 128
+
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32),
+                        method=model.logits)["params"]
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype="fp32" if on_cpu else "bf16", mp_size=1)
+
+    gen = np.random.default_rng(0)
+    # one workload, replayed identically into both arms: bursty Poisson
+    # arrivals, mixed prompt lengths, mixed generation budgets (length
+    # spread is exactly what gang scheduling wastes slots on)
+    arrivals = np.cumsum(gen.exponential(1.0 / rate, size=n_req))
+    prompts = [gen.integers(0, cfg.vocab_size,
+                            size=int(gen.integers(len_lo, len_hi + 1))
+                            ).astype(np.int32) for _ in range(n_req)]
+    budgets = gen.integers(gen_lo, gen_hi + 1, size=n_req)
+
+    def run_arm(policy: str) -> dict:
+        srv = ServingEngine(engine, num_slots=slots, max_queue_depth=n_req,
+                            policy=policy)
+        t0 = time.perf_counter()
+        i = 0
+        while i < n_req or srv.pending or srv.live_count:
+            now = time.perf_counter() - t0
+            while i < n_req and arrivals[i] <= now:
+                srv.submit(prompts[i], max_new_tokens=int(budgets[i]))
+                i += 1
+            if not (srv.pending or srv.live_count):
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+                continue
+            srv.step()
+        return srv.stats()
+
+    # warmup: compile every prefill bucket + admit + decode + sample once;
+    # must include len_hi so the TOP bucket is compiled before timing starts
+    warm = ServingEngine(engine, num_slots=slots, max_queue_depth=n_req)
+    w = len_lo
+    while True:
+        warm.submit(np.zeros((w,), np.int32), max_new_tokens=2)
+        if w >= len_hi:
+            break
+        w = min(w * 2, len_hi)
+    warm.run_until_drained()
+
+    cont = run_arm("continuous")
+    gang = run_arm("gang")
+
+    def arm_detail(s):
+        return {"requests_per_s": round(s["requests_per_s"], 3),
+                "tokens_per_s": round(s["tokens_per_s"], 1),
+                "ttft_p50_ms": round(s["ttft_p50_ms"], 1),
+                "ttft_p99_ms": round(s["ttft_p99_ms"], 1),
+                "per_token_p50_ms": round(s["per_token_p50_ms"], 2),
+                "completed": s["completed"]}
+
+    print(json.dumps({
+        "metric": f"continuous-batching serving, Poisson arrivals "
+                  f"({n_req} req @ {rate}/s, {slots} slots, prompts "
+                  f"{len_lo}-{len_hi}, budgets {gen_lo}-{gen_hi})",
+        "value": round(cont["requests_per_s"], 3),
+        "unit": "req/s",
+        "vs_baseline": round(cont["requests_per_s"] / gang["requests_per_s"],
+                             3),
+        "detail": {
+            "baseline": "gang (batch-synchronous) admission at equal slot "
+                        "count — the generate() discipline on the same "
+                        "engine and kernels",
+            "continuous": arm_detail(cont),
+            "gang": arm_detail(gang),
+        },
+    }))
+
+
 if __name__ == "__main__":
+    import sys
+
+    entry = serving_main if "serving" in sys.argv[1:] else main
     # the tunneled backend's remote-compile service intermittently 500s
     # (observed r3: "tpu_compile_helper subprocess exit code 1" for ~hours);
     # retry with backoff so a transient outage doesn't zero the round
     attempts = 6
     for attempt in range(attempts):
         try:
-            main()
+            entry()
             break
         except Exception as e:  # noqa: BLE001
             if attempt == attempts - 1:

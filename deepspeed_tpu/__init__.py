@@ -111,6 +111,24 @@ def init_inference(model: Any = None, config: Union[str, Dict, None] = None, **k
     return InferenceEngine(model=model, config=config, **kwargs)
 
 
+def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
+                 num_slots: int = 4, max_queue_depth: int = 64, **kwargs):
+    """Build a continuous-batching server: :func:`init_inference` for the
+    engine, then wrap it in :class:`serving.ServingEngine` (slot-pooled KV
+    cache, FIFO admission, per-request SLO metrics). Serving-only knobs
+    (``policy``, ``do_sample``, ``temperature``, ``top_k``, ``top_p``,
+    ``seed``, ``monitor``) pass through to ServingEngine; everything else
+    configures the inference engine."""
+    from .serving.engine import ServingEngine
+
+    serve_keys = ("policy", "do_sample", "temperature", "top_k", "top_p",
+                  "seed", "monitor")
+    serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
+    engine = init_inference(model=model, config=config, **kwargs)
+    return ServingEngine(engine, num_slots=num_slots,
+                         max_queue_depth=max_queue_depth, **serve_kwargs)
+
+
 def add_config_arguments(parser):
     """Inject --deepspeed / --deepspeed_config CLI args (≅ reference
     deepspeed/__init__.py:237)."""

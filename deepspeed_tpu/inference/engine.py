@@ -253,6 +253,15 @@ class InferenceEngine:
                 method=prefill_gen, mutable=["cache"])
             return out, vars_["cache"]
 
+        def prefill_at_fn(params, input_ids, last_pos):
+            # serving-path prefill: prompts are right-padded to a shape
+            # bucket (bounds recompiles across arbitrary prompt lengths)
+            # and ``last_pos`` projects the true last prompt position
+            out, vars_ = module.apply(
+                {"params": dequant(params)}, input_ids, last_pos,
+                method=prefill_gen, mutable=["cache"])
+            return out, vars_["cache"]
+
         def decode_fn(params, cache, token, pos):
             out, vars_ = module.apply(
                 {"params": dequant(params), "cache": cache}, token, pos,
@@ -301,6 +310,8 @@ class InferenceEngine:
         self._jit_prefill = jax.jit(prefill_fn)
         self._jit_prefill_gen = jax.jit(prefill_last_fn) \
             if prefill_gen is not None else self._jit_prefill
+        self._jit_prefill_at = jax.jit(prefill_at_fn) \
+            if prefill_gen is not None else None
         self._jit_decode = jax.jit(decode_fn, donate_argnums=(1,))
         self._jit_sample = jax.jit(sample_fn, static_argnums=(3, 4))
         self._jit_decode_scan = jax.jit(decode_scan_fn,
@@ -394,6 +405,24 @@ class InferenceEngine:
         self._decode_scan_execs[key] = compiled
         return compiled
 
+    def kv_cache_spec(self):
+        """The served module's declared KV-cache contract, or None when it
+        doesn't declare one (foreign modules). The serving subsystem sizes
+        its slot pool from this."""
+        module = getattr(self, "_serve_module", None) or self.module
+        spec_fn = getattr(module, "kv_cache_spec", None)
+        if not callable(spec_fn):
+            return None
+        try:
+            return spec_fn()
+        except Exception:  # noqa: BLE001 — foreign modules may need state
+            return None
+
+    def _declared_kv_capacity(self) -> Optional[int]:
+        spec = self.kv_cache_spec()
+        cap = getattr(spec, "max_seq_len", None)
+        return int(cap) if cap is not None else None
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: Optional[float] = None,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
@@ -424,19 +453,21 @@ class InferenceEngine:
         top_p = cfg.top_p if top_p is None else top_p
         greedy = jnp.asarray(not do_sample)
 
-        # Cache avals from a shape-only prefill: the capacity check and the
-        # decode-program compile both happen BEFORE any cache buffer lives.
-        # The allocated KV capacity is the LAST dim of the cache k/v
-        # leaves — the positions-minor layout (B, KV, D, capacity), or
-        # (L, B, KV, D, capacity) when layers are nn.scan-stacked —
-        # authoritative even when the model config lacks max_seq_len.
-        # Steps past capacity would write out of bounds (silently clamped
-        # by JAX today, but fragile); fail loudly.
+        # Cache avals from a shape-only prefill: the decode-program compile
+        # happens BEFORE any cache buffer lives. The allocated KV capacity
+        # comes from the module's DECLARED kv_cache_spec when it has one
+        # (the allocation contract — ADVICE r5; the serving slot pool
+        # consumes the same spec), falling back to the last dim of ndim>=4
+        # cache leaves (positions-minor layout) only for foreign modules
+        # that declare nothing. Steps past capacity would write out of
+        # bounds (silently clamped by JAX today, but fragile); fail loudly.
         _, cache_aval = jax.eval_shape(self._jit_prefill_gen, self.params,
                                        input_ids)
-        cache_cap = max((x.shape[-1]
-                         for x in jax.tree_util.tree_leaves(cache_aval)
-                         if getattr(x, "ndim", 0) >= 4), default=None)
+        cache_cap = self._declared_kv_capacity()
+        if cache_cap is None:
+            cache_cap = max((x.shape[-1]
+                             for x in jax.tree_util.tree_leaves(cache_aval)
+                             if getattr(x, "ndim", 0) >= 4), default=None)
         caps = [c for c in (max_len, cache_cap) if c is not None]
         capacity = min(caps) if caps else None
         if capacity is not None and T + max_new_tokens > capacity:

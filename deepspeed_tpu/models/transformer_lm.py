@@ -74,15 +74,18 @@ class TransformerConfig:
     kv_cache_quant: bool = False        # int8 KV cache (per-row scales):
     # halves the cache's HBM traffic — the resource decode is bound by —
     # and halves KV memory, doubling the servable context per chip
-    kv_cache_packed: bool = True        # store the int8 cache in an int32
-    # container (pack_int8_sublanes: 4 head-dim rows per word, the TPU's
-    # own sublane byte order, so the kernel unpacks with a free
+    kv_cache_packed: Optional[bool] = None  # store the int8 cache in an
+    # int32 container (pack_int8_sublanes: 4 head-dim rows per word, the
+    # TPU's own sublane byte order, so the kernel unpacks with a free
     # pltpu.bitcast). Same bytes in a natively-tiled dtype — insurance
     # against Mosaic's (4,1)-packed s8 layout-conversion copies (the
     # round-4/5 capacity killer; the positions-minor layout + carry-DUS
     # scan fixed the measured cases, and packed/plain now measure equal —
     # BASELINE.md round-5 capacity ladder). Only meaningful with
-    # kv_cache_quant; requires head_dim % 4 == 0.
+    # kv_cache_quant; requires head_dim % 4 == 0. Tri-state: None (auto,
+    # the default) packs when head_dim allows and warns once when it
+    # can't; True requires a packable head_dim (raises otherwise);
+    # False keeps the plain int8 container.
     int8_weights: bool = False          # serve with int8-at-rest Dense kernels
     int8_kernel: str = "auto"           # auto | on | off (Pallas dequant-GEMM)
     int8_head: bool = False             # quantize lm_head too (off: the vocab
@@ -289,8 +292,13 @@ class CachedAttention(nn.Module):
             # double-buffers the quantized cache above ~100 MB:
             # BASELINE.md round-5 capacity section.)
             assert kv_cache is not None, "decode needs the kv_cache slice"
+            # ``start`` is scalar () for batch-uniform decode (generate),
+            # or (B,) for slot-pooled decode where every sequence sits at
+            # its own cache offset (serving/ continuous batching)
             start = kv_cache["start"]
-            positions = start + jnp.arange(T)[None, :]
+            per_slot = jnp.ndim(start) == 1
+            positions = (start[:, None] if per_slot else start) \
+                + jnp.arange(T)[None, :]
         else:
             start = jnp.zeros((), jnp.int32)
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
@@ -313,6 +321,20 @@ class CachedAttention(nn.Module):
             k_rows = k.astype(cfg.dtype).transpose(0, 2, 1, 3)  # (B,KV,T,D)
             v_rows = v.astype(cfg.dtype).transpose(0, 2, 1, 3)
             new_cache = dict(kv_cache)
+
+            def store(buf, new):
+                """Write the new positions-minor columns at each row's
+                offset: one DUS for scalar start; per-slot (B,) starts
+                vmap the DUS over the batch (lowers to a scatter — each
+                slot writes at its own cache offset)."""
+                if per_slot:
+                    return jax.vmap(
+                        lambda c, n, s: jax.lax.dynamic_update_slice(
+                            c, n, (0,) * (c.ndim - 1) + (s,)))(buf, new,
+                                                               start)
+                return jax.lax.dynamic_update_slice(
+                    buf, new, (0,) * (buf.ndim - 1) + (start,))
+
             if cfg.kv_cache_quant:
                 from ..ops.attention.decode_attention import (
                     pack_int8_sublanes,
@@ -321,20 +343,16 @@ class CachedAttention(nn.Module):
 
                 k_rows, k_sc = quantize_kv_rows(k_rows)
                 v_rows, v_sc = quantize_kv_rows(v_rows)
-                new_cache["k_scale"] = jax.lax.dynamic_update_slice(
-                    kv_cache["k_scale"], k_sc, (0, 0, start))
-                new_cache["v_scale"] = jax.lax.dynamic_update_slice(
-                    kv_cache["v_scale"], v_sc, (0, 0, start))
+                new_cache["k_scale"] = store(kv_cache["k_scale"], k_sc)
+                new_cache["v_scale"] = store(kv_cache["v_scale"], v_sc)
             # positions-minor store: new rows become (B, KV, D, T) columns
             k_cols = k_rows.transpose(0, 1, 3, 2)
             v_cols = v_rows.transpose(0, 1, 3, 2)
             if kv_packed:
                 k_cols = pack_int8_sublanes(k_cols)  # (B, KV, D//4, T)
                 v_cols = pack_int8_sublanes(v_cols)
-            new_cache["k"] = jax.lax.dynamic_update_slice(
-                kv_cache["k"], k_cols, (0, 0, 0, start))
-            new_cache["v"] = jax.lax.dynamic_update_slice(
-                kv_cache["v"], v_cols, (0, 0, 0, start))
+            new_cache["k"] = store(kv_cache["k"], k_cols)
+            new_cache["v"] = store(kv_cache["v"], v_cols)
             if T == 1 and self._use_decode_kernel(cfg.max_seq_len,
                                                   deterministic):
                 # fused Pallas decode attention (reference softmax_context,
@@ -380,9 +398,15 @@ class CachedAttention(nn.Module):
                     # multiple GB at long S); fold the per-row scales into
                     # the score and probability tensors, as the kernel does
                     kv_scales = (new_cache["k_scale"], new_cache["v_scale"])
-                # row t may see cache slots [0, start+t]
-                mask = (jnp.arange(S)[None, :]
-                        <= (start + jnp.arange(T))[:, None])
+                # row t may see cache slots [0, start+t]; per-slot starts
+                # make the mask batch-dependent: (B, T, S) instead of (T, S)
+                if per_slot:
+                    mask = (jnp.arange(S)[None, None, :]
+                            <= (start[:, None]
+                                + jnp.arange(T)[None, :])[:, :, None])
+                else:
+                    mask = (jnp.arange(S)[None, :]
+                            <= (start + jnp.arange(T))[:, None])
         if fresh:
             if self._use_flash(T, deterministic):
                 # fused Pallas flash attention for the full-context forward
@@ -429,10 +453,18 @@ class CachedAttention(nn.Module):
                              k_all.astype(jnp.float32)) * scale
         if cfg.pos_emb == "alibi":
             slopes = alibi_slopes(H)  # (H,)
-            kpos = jnp.arange(S)[None, :]
-            qpos = (start + jnp.arange(T))[:, None]
-            att = att + slopes[None, :, None, None] * (kpos - qpos)[None, None]
-        att = jnp.where(mask[None, None], att, -1e30)
+            if decode and jnp.ndim(start) == 1:
+                # per-slot decode: relative key offsets differ per batch row
+                rel = (jnp.arange(S)[None, None, :]
+                       - (start[:, None] + jnp.arange(T)[None, :])[:, :, None])
+                att = att + slopes[None, :, None, None] * rel[:, None]
+            else:
+                kpos = jnp.arange(S)[None, :]
+                qpos = (start + jnp.arange(T))[:, None]
+                att = att + slopes[None, :, None, None] \
+                    * (kpos - qpos)[None, None]
+        att = jnp.where(mask[None, None] if mask.ndim == 2 else mask[:, None],
+                        att, -1e30)
         att = jax.nn.softmax(att, axis=-1)
         if cfg.dropout > 0:
             att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
@@ -523,6 +555,9 @@ class _ScanBlock(nn.Module):
         return (x, cache, start, li + 1), None
 
 
+_PACK_DISABLED_WARNED: set = set()
+
+
 def kv_cache_spec(cfg: TransformerConfig):
     """The single source of truth for the KV-cache container: returns
     ``(cache_dtype, cache_d, kv_packed)`` — the per-layer k/v arrays are
@@ -530,7 +565,23 @@ def kv_cache_spec(cfg: TransformerConfig):
     writes), _CacheStore (allocation) and make_layer_kv_cache
     (ZeRO-Inference allocation) so the layout can never drift apart."""
     D = cfg.head_dim
-    kv_packed = (cfg.kv_cache_quant and cfg.kv_cache_packed and D % 4 == 0)
+    if cfg.kv_cache_quant and cfg.kv_cache_packed is not False and D % 4 != 0:
+        if cfg.kv_cache_packed is True:
+            raise ValueError(
+                f"kv_cache_packed=True requires head_dim % 4 == 0 (the int32 "
+                f"container packs 4 head-dim rows per word); head_dim={D}. "
+                f"Use kv_cache_packed=None (auto) or False, or pad n_embd.")
+        if D not in _PACK_DISABLED_WARNED:  # auto: warn once per head_dim
+            _PACK_DISABLED_WARNED.add(D)
+            from ..utils.logging import logger
+
+            logger.warning(
+                f"int32 KV-cache packing disabled: head_dim={D} is not a "
+                f"multiple of 4; falling back to the plain int8 container "
+                f"(risk: Mosaic's (4,1)-packed s8 carry layout — see "
+                f"kv_cache_packed in TransformerConfig)")
+    kv_packed = (cfg.kv_cache_quant and cfg.kv_cache_packed is not False
+                 and D % 4 == 0)
     if kv_packed:
         return jnp.int32, D // 4, True
     if cfg.kv_cache_quant:
@@ -538,22 +589,68 @@ def kv_cache_spec(cfg: TransformerConfig):
     return cfg.dtype, D, False
 
 
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    """Module-declared KV-cache allocation contract: everything an engine
+    needs to size, allocate and bound a cache WITHOUT inferring layout
+    from pytree leaf shapes (ADVICE r5). ``stacked_cache``/``layer_cache``
+    build zeroed containers in the exact layout CachedAttention reads and
+    writes; the serving slot pool allocates through this (batch dim =
+    slots) and ``InferenceEngine.generate`` takes ``max_seq_len`` as the
+    authoritative capacity."""
+
+    n_layer: int
+    kv_heads: int
+    head_dim: int          # logical per-head width
+    cache_d: int           # stored sublane dim (head_dim, or //4 packed)
+    dtype: Any
+    max_seq_len: int
+    quantized: bool
+    packed: bool
+
+    def layer_cache(self, batch_size: int) -> dict:
+        """Zeroed single-layer k/v dict: (B, KV, cache_d, S) [+ scales]."""
+        shape = (batch_size, self.kv_heads, self.cache_d, self.max_seq_len)
+        cache = {"k": jnp.zeros(shape, self.dtype),
+                 "v": jnp.zeros(shape, self.dtype)}
+        if self.quantized:
+            sshape = (batch_size, self.kv_heads, self.max_seq_len)
+            cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        return cache
+
+    def stacked_cache(self, batch_size: int) -> dict:
+        """Zeroed L-stacked cache dict matching the ``cache_store`` flax
+        variables: k/v (L, B, KV, cache_d, S) [+ scales (L, B, KV, S)],
+        plus a per-sequence ``index`` (B,) int32 — the vector-start form
+        CachedAttention accepts for slot-pooled decode."""
+        L = self.n_layer
+        shape = (L, batch_size, self.kv_heads, self.cache_d,
+                 self.max_seq_len)
+        cache = {"k": jnp.zeros(shape, self.dtype),
+                 "v": jnp.zeros(shape, self.dtype),
+                 "index": jnp.zeros((batch_size,), jnp.int32)}
+        if self.quantized:
+            sshape = (L, batch_size, self.kv_heads, self.max_seq_len)
+            cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        return cache
+
+
+def make_kv_cache_spec(cfg: TransformerConfig) -> KVCacheSpec:
+    cache_dtype, cache_d, packed = kv_cache_spec(cfg)
+    return KVCacheSpec(n_layer=cfg.n_layer, kv_heads=cfg.kv_heads,
+                       head_dim=cfg.head_dim, cache_d=cache_d,
+                       dtype=cache_dtype, max_seq_len=cfg.max_seq_len,
+                       quantized=cfg.kv_cache_quant, packed=packed)
+
+
 def make_layer_kv_cache(cfg: TransformerConfig, batch_size: int) -> dict:
     """Zeroed SINGLE-LAYER KV cache dict — the explicit functional form
     of one _CacheStore slice, for callers that stream layers one at a
     time (ZeRO-Inference) and thread the cache themselves. Add a
     ``start`` scalar before passing to TransformerBlock."""
-    cache_dtype, cache_d, _ = kv_cache_spec(cfg)
-    KV = cfg.kv_heads
-    cache = {"k": jnp.zeros((batch_size, KV, cache_d, cfg.max_seq_len),
-                            cache_dtype),
-             "v": jnp.zeros((batch_size, KV, cache_d, cfg.max_seq_len),
-                            cache_dtype)}
-    if cfg.kv_cache_quant:
-        sshape = (batch_size, KV, cfg.max_seq_len)
-        cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
-        cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
-    return cache
+    return make_kv_cache_spec(cfg).layer_cache(batch_size)
 
 
 class _CacheStore(nn.Module):
@@ -602,6 +699,14 @@ class TransformerLM(nn.Module):
     ``decode`` with the ``cache`` collection."""
 
     config: TransformerConfig
+
+    def kv_cache_spec(self) -> KVCacheSpec:
+        """Module-declared KV-cache contract (shape/dtype/capacity of the
+        ``cache_store`` variables). Engines size and bound caches from
+        THIS — not from inferring axis positions off pytree leaves — and
+        the serving slot pool allocates through it (batch dim = slots).
+        Safe to call on an unbound module: reads only ``self.config``."""
+        return make_kv_cache_spec(self.config)
 
     def setup(self):
         cfg = self.config
@@ -675,29 +780,44 @@ class TransformerLM(nn.Module):
         pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
         return self._transform(input_ids, pos, "prefill", True)
 
-    def prefill_last(self, input_ids):
+    def prefill_last(self, input_ids, last_pos=None):
         """Prefill variant for GENERATION: fills the cache but projects
         only the LAST position onto the vocabulary, returning (B, 1, V)
         logits. Sampling uses only the last position, and the full
         (B, T, V) fp32 logits are the largest prefill allocation
         (~0.8 GB at B=8/T=512/V=50k — measured as the binding constraint
         on the 32k serving row, BASELINE.md); scoring callers keep
-        ``prefill``."""
+        ``prefill``.
+
+        ``last_pos`` (scalar or (B,) int32, optional) selects WHICH
+        position to project instead of T-1 — the serving path right-pads
+        prompts to a shape bucket (bounded prefill recompiles) and
+        projects the true last prompt token; causal attention keeps that
+        position's hidden state independent of the right padding."""
         B, T = input_ids.shape
         pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
         x = self._transform(input_ids, pos, "prefill", True, head=False)
-        return self._project_head(x[:, -1:])
+        if last_pos is None:
+            x = x[:, -1:]
+        else:
+            idx = jnp.broadcast_to(jnp.asarray(last_pos, jnp.int32), (B,))
+            x = jax.vmap(lambda xb, i: jax.lax.dynamic_slice_in_dim(
+                xb, i, 1, 0))(x, idx)
+        return self._project_head(x)
 
     def decode(self, input_ids, start_pos, block_hint=None):
         """One (or few) token step against the cache; ``start_pos`` is the
-        current cache length (B-uniform). Call with ``mutable=["cache"]``.
+        current cache length — scalar for a B-uniform batch, or (B,) for
+        slot-pooled decode where every sequence sits at its own offset
+        (continuous batching). Call with ``mutable=["cache"]``.
         ``block_hint`` (STATIC int) overrides the fused kernel's block
         granule — an explicit expert option; engine.generate keeps the
         allocation-based default after a budget-derived hint measured
         net-negative (grid overhead dominates dead-row reads;
         BASELINE.md round-5 KV e2e section)."""
         B, T = input_ids.shape
-        pos = start_pos + jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        off = start_pos[:, None] if jnp.ndim(start_pos) == 1 else start_pos
+        pos = off + jnp.broadcast_to(jnp.arange(T)[None], (B, T))
         return self._transform(input_ids, pos, True, True, block_hint)
 
     def __call__(self, batch, deterministic: bool = False):
@@ -712,20 +832,24 @@ class TransformerLM(nn.Module):
             # streaming loss: never materialize the (B, T, V) logits
             from ..ops.transformer.chunked_xent import chunked_softmax_xent
 
-            if cfg.int8_weights and cfg.int8_head:
-                raise ValueError(
-                    "loss_chunk does not compose with an int8-quantized "
-                    "lm_head (QuantDense stores an int8 kernel + scale; "
-                    "the streaming loss reads a plain kernel). Serve "
-                    "int8 with the dense loss, or keep the head fp32.")
             B, T = input_ids.shape
             pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
             x = self._transform(input_ids, pos, False, deterministic,
                                 head=False)[:, :-1]
             if cfg.tie_word_embeddings:
-                # Embed.attend promotes both operands to cfg.dtype
+                # Embed.attend promotes both operands to cfg.dtype; the
+                # embedding table is never quantized (quantize_lm_params
+                # converts only Dense kernels), so int8_weights+int8_head
+                # is fine here — the guard below is untied-only
                 w, cd = self.embed_tokens.embedding, cfg.dtype
             else:
+                if cfg.int8_weights and cfg.int8_head:
+                    raise ValueError(
+                        "loss_chunk does not compose with an int8-quantized "
+                        "untied lm_head (QuantDense stores an int8 kernel + "
+                        "scale; the streaming loss reads a plain kernel). "
+                        "Serve int8 with the dense loss, keep the head fp32, "
+                        "or tie the embeddings.")
                 if self.is_initializing():
                     # create the head's params (the streaming path reads
                     # the kernel without calling the module)

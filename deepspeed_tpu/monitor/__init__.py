@@ -1,0 +1,9 @@
+"""Monitor namespace (≅ reference ``deepspeed.monitor``): the
+``(tag, value, step)`` event sinks. Both training and the serving
+subsystem emit through :class:`MonitorMaster`."""
+
+from .monitor import (Event, Monitor, MonitorMaster,  # noqa: F401
+                      TensorBoardMonitor, WandbMonitor, csvMonitor)
+
+__all__ = ["Event", "Monitor", "MonitorMaster", "TensorBoardMonitor",
+           "WandbMonitor", "csvMonitor"]

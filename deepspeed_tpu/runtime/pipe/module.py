@@ -75,19 +75,35 @@ class TiedLayerSpec(LayerSpec):
         self.forward_fn = forward_fn
 
 
-def block_passes_deterministic(typename: type) -> bool:
-    """True when the block's ``__call__`` takes a positional ``deterministic``
-    flag (self, x, deterministic) — shared by the GPipe and 1F1B executors so
-    both pass the flag identically."""
+def block_call_mode(typename: type) -> str:
+    """How the pipeline executors invoke a block — shared by the GPipe and
+    1F1B executors so both pass flags identically:
+
+    * ``"decode_det"`` — ``__call__(self, x, decode, deterministic, ...)``,
+      the inference-capable TransformerBlock family: executors pin
+      ``decode=False`` (training) and thread ``deterministic`` into the
+      right slot (passing it positionally would land in ``decode``).
+    * ``"det"`` — ``__call__(self, x, deterministic)``: the flag is the
+      second argument.
+    * ``"plain"`` — ``__call__(self, x)``.
+    """
     import inspect
 
     try:
         sig = inspect.signature(typename.__call__)
-        return len([p for p in sig.parameters.values()
-                    if p.kind in (p.POSITIONAL_ONLY,
-                                  p.POSITIONAL_OR_KEYWORD)]) >= 3
     except (TypeError, ValueError):
-        return False
+        return "plain"
+    names = [p.name for p in sig.parameters.values()
+             if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    names = names[1:]  # drop self
+    if "decode" in names and "deterministic" in names:
+        return "decode_det"
+    return "det" if len(names) >= 2 else "plain"
+
+
+def block_passes_deterministic(typename: type) -> bool:
+    """Back-compat shim for the old boolean call-mode probe."""
+    return block_call_mode(typename) == "det"
 
 
 def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
@@ -133,26 +149,34 @@ def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
 
 
 class _PipeScanBody(nn.Module):
-    """nn.scan body adapter: user blocks return x; scan needs (carry, out)."""
+    """nn.scan body adapter: user blocks return x (or ``(x, aux)``); scan
+    needs (carry, out)."""
 
     block_cls: type
     block_args: Tuple = ()
     block_kwargs: Tuple = ()  # sorted (key, value) pairs — hashable for flax
     remat: bool = True
 
-    pass_deterministic: bool = False
+    call_mode: str = "plain"  # see block_call_mode
 
     @nn.compact
     def __call__(self, x, deterministic=True):
         cls = self.block_cls
         if self.remat:
-            cls = nn.remat(cls, prevent_cse=False,
-                           static_argnums=(2,) if self.pass_deterministic else ())
+            static = {"det": (2,), "decode_det": (2, 3)}.get(self.call_mode, ())
+            cls = nn.remat(cls, prevent_cse=False, static_argnums=static)
         block = cls(*self.block_args, **dict(self.block_kwargs), name="block")
-        if self.pass_deterministic:
+        if self.call_mode == "decode_det":
+            x = block(x, False, deterministic)
+        elif self.call_mode == "det":
             x = block(x, deterministic)
         else:
             x = block(x)
+        if isinstance(x, tuple):
+            # inference-capable blocks return (x, new_cache); in training
+            # (decode=False, no cache threaded) the aux entry is dead —
+            # keep only the activation so the scan carry structure holds
+            x = x[0]
         return x, None
 
 
@@ -167,7 +191,7 @@ class _PipeTick(nn.Module):
     remat: bool = True
     num_stages: int = 1
     num_blocks: int = 1
-    pass_deterministic: bool = False
+    call_mode: str = "plain"
 
     def setup(self):
         L, S = self.num_blocks, self.num_stages
@@ -187,7 +211,7 @@ class _PipeTick(nn.Module):
             metadata_params={nn.PARTITION_NAME: PIPE_AXIS},
         )(block_cls=self.block_cls, block_args=self.block_args,
           block_kwargs=self.block_kwargs, remat=self.remat,
-          pass_deterministic=self.pass_deterministic, name="blocks")
+          call_mode=self.call_mode, name="blocks")
 
     def __call__(self, carry, t, embedded, deterministic):
         state = carry
@@ -266,7 +290,7 @@ class PipelineModule(nn.Module):
         self._post_specs = tuple(post_specs)
 
         spec0 = block_specs[0]
-        pass_det = block_passes_deterministic(spec0.typename)
+        call_mode = block_call_mode(spec0.typename)
         # lifted scan over ticks: params broadcast across iterations
         self.ticks = nn.scan(
             _PipeTick,
@@ -278,7 +302,7 @@ class PipelineModule(nn.Module):
           block_kwargs=tuple(sorted(spec0.module_kwargs.items())),
           remat=bool(self.activation_checkpoint_interval),
           num_stages=self.num_stages, num_blocks=len(block_specs),
-          pass_deterministic=pass_det, name="pipe")
+          call_mode=call_mode, name="pipe")
         self._num_blocks = len(block_specs)
 
     def _embed(self, micro_batch):
@@ -298,9 +322,16 @@ class PipelineModule(nn.Module):
         leaves = jax.tree_util.tree_leaves(stacked_batch)
         M = leaves[0].shape[0]
 
+        def micro_at(i):
+            return jax.tree_util.tree_map(lambda x: x[i], stacked_batch)
+
         # embed all micros up front (pre params replicated over pipe; this
-        # compute is tiny vs the blocks and keeps the tick body homogeneous)
-        embedded = jax.vmap(self._embed)(stacked_batch)  # (M, mb, T, D)
+        # compute is tiny vs the blocks and keeps the tick body homogeneous).
+        # Unrolled per-micro rather than jax.vmap'd: submodule calls inside a
+        # raw jax transform trip flax's trace-level check (JaxTransformError)
+        # — the lifted-transform rule; M is small and static so unrolling is
+        # the simplest legal form
+        embedded = jnp.stack([self._embed(micro_at(i)) for i in range(M)])
         feat_shape = embedded.shape[1:]
 
         state0 = jnp.zeros((S,) + feat_shape, embedded.dtype)
@@ -311,7 +342,8 @@ class PipelineModule(nn.Module):
 
         # head + loss at module level: tied modules (e.g. embedding reused as
         # LM head via TiedLayerSpec.forward_fn) share one scope here
-        losses = jax.vmap(self._head_loss)(outputs, stacked_batch)
+        losses = jnp.stack([self._head_loss(outputs[i], micro_at(i))
+                            for i in range(M)])
         return jnp.mean(losses)
 
     def num_pipeline_ticks(self, num_micro_batches: int) -> int:

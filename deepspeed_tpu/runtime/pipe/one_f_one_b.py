@@ -89,9 +89,9 @@ def make_1f1b_grads(module) -> Callable:
     spec0 = block_specs[0]
     n_local = len(block_specs) // S
 
-    from .module import block_passes_deterministic
+    from .module import block_call_mode
 
-    pass_det = block_passes_deterministic(spec0.typename)
+    call_mode = block_call_mode(spec0.typename)
     block = spec0.build()
 
     def chain(stage_params, x, keys, deterministic):
@@ -100,11 +100,19 @@ def make_1f1b_grads(module) -> Callable:
         def body(h, xs):
             layer_params, key = xs
             rngs = {"dropout": key, "gating": jax.random.fold_in(key, 1)}
-            if pass_det:
+            if call_mode == "decode_det":
+                # inference-capable blocks (x, decode, deterministic, ...):
+                # pin decode=False for training so the deterministic flag
+                # can't land in the decode slot positionally
+                h = block.apply({"params": layer_params}, h, False,
+                                deterministic, rngs=rngs)
+            elif call_mode == "det":
                 h = block.apply({"params": layer_params}, h, deterministic,
                                 rngs=rngs)
             else:
                 h = block.apply({"params": layer_params}, h, rngs=rngs)
+            if isinstance(h, tuple):
+                h = h[0]  # (x, new_cache) blocks: drop the dead aux entry
             return h, None
 
         h, _ = jax.lax.scan(body, x, (stage_params, keys))

@@ -1,0 +1,207 @@
+"""ServingEngine: request-level continuous batching over InferenceEngine.
+
+``InferenceEngine.generate()`` is whole-batch synchronous — every
+request must arrive together and the batch holds its slots until the
+slowest member finishes. This front-end turns the same compiled
+machinery (the jitted ``prefill_last`` and donated single-step decode)
+into a server: requests arrive one at a time via :meth:`submit`, each
+:meth:`step` admits queued prompts into free slots of the fixed-shape
+:class:`~deepspeed_tpu.serving.slot_pool.SlotPool` and runs ONE decode
+step for all live slots, and finished sequences retire immediately so
+their slot goes back to work (Orca-style iteration-level scheduling;
+PAPERS.md).
+
+Shape discipline is what keeps this fast on TPU: the decode step always
+runs at batch = ``num_slots`` with per-slot (B,) cache offsets, so slot
+churn never changes a compiled program — dead slots ride along as
+masked padding. Prompt prefills are right-padded to power-of-two
+buckets and the true last position is projected via
+``prefill_last(input_ids, last_pos)``, bounding prefill recompiles at
+log2(max_seq_len) for arbitrary prompt lengths.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist
+from .metrics import ServingMetrics
+from .request import Request, RequestState
+from .scheduler import FIFOScheduler
+from .slot_pool import SlotPool
+
+_MIN_PREFILL_BUCKET = 16
+
+
+class ServingEngine:
+    """Continuous-batching server over a built
+    :class:`~deepspeed_tpu.inference.engine.InferenceEngine`.
+
+    Construct via :func:`deepspeed_tpu.init_serving`. Sampling knobs
+    default to the inference config's (greedy unless ``do_sample``);
+    they are server-global — per-request ``max_new_tokens`` and
+    ``eos_token_id`` ride on the :class:`Request`.
+    """
+
+    def __init__(self, engine: Any, num_slots: int = 4,
+                 max_queue_depth: int = 64, policy: str = "continuous",
+                 do_sample: bool = False,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 seed: int = 0, monitor: Optional[Any] = None):
+        self.engine = engine
+        # materialize params + jits before sizing anything off the module
+        engine._ensure_params(jnp.zeros((1, 2), jnp.int32))
+        spec = engine.kv_cache_spec()
+        if spec is None:
+            raise ValueError(
+                "serving requires the module to declare kv_cache_spec() "
+                "(the slot pool allocates through it); the unified "
+                "TransformerLM family does")
+        if getattr(engine, "_jit_prefill_at", None) is None:
+            raise ValueError(
+                "serving requires the module to expose prefill_last("
+                "input_ids, last_pos) for bucketed slot prefill")
+        cfg = engine._config
+        self.pool = SlotPool(spec, num_slots)
+        self.scheduler = FIFOScheduler(num_slots, max_queue_depth,
+                                       policy=policy,
+                                       capacity=self.pool.capacity)
+        self.metrics = ServingMetrics(monitor)
+        self.temperature = cfg.temperature if temperature is None else temperature
+        self.top_k = cfg.top_k if top_k is None else top_k
+        self.top_p = cfg.top_p if top_p is None else top_p
+        self._greedy = jnp.asarray(not do_sample)
+        self._rng = jax.random.PRNGKey(seed)
+        self._slot_req: dict = {}                      # slot -> Request
+        self._current = np.zeros((num_slots,), np.int32)  # last token per slot
+        self._next_id = 0
+        self._now = time.perf_counter
+        log_dist(f"ServingEngine: slots={num_slots} policy={policy} "
+                 f"capacity={self.pool.capacity} "
+                 f"max_queue_depth={max_queue_depth}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    @property
+    def live_count(self) -> int:
+        return len(self._slot_req)
+
+    @property
+    def pending(self) -> int:
+        return self.scheduler.pending
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> Request:
+        """Enqueue one generation request. Never raises on load: admission
+        control marks the returned request ``REJECTED`` with a
+        ``reject_reason`` (``"queue_full"``, ``"prompt_too_long"``) so
+        callers can shed or retry."""
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        req = Request(self._next_id, prompt, max_new_tokens, eos_token_id)
+        self._next_id += 1
+        req.submit_time = self._now()
+        accepted, reason = self.scheduler.submit(req)
+        if not accepted:
+            req.state = RequestState.REJECTED
+            req.reject_reason = reason
+            self.metrics.record_rejection(req)
+        return req
+
+    # ------------------------------------------------------------------
+    def _sample(self, logits) -> np.ndarray:
+        self._rng, sub = jax.random.split(self._rng)
+        return np.asarray(self.engine._jit_sample(
+            logits, sub, jnp.asarray(self.temperature, jnp.float32),
+            int(self.top_k), float(self.top_p), self._greedy))
+
+    @staticmethod
+    def _bucket(n: int, cap: int) -> int:
+        b = _MIN_PREFILL_BUCKET
+        while b < n:
+            b *= 2
+        return min(b, cap)
+
+    def _admit(self, req: Request, finished: List[Request]) -> None:
+        eng = self.engine
+        slot = self.pool.alloc()
+        T = req.prompt_len
+        width = self._bucket(T, self.pool.capacity)
+        ids = np.zeros((1, width), np.int32)
+        ids[0, :T] = req.prompt
+        req.admit_time = self._now()
+        logits, pre_cache = eng._jit_prefill_at(
+            eng.params, jnp.asarray(ids), jnp.asarray(T - 1, jnp.int32))
+        self.pool.admit(pre_cache, slot, T)
+        token = int(self._sample(logits)[0])   # device sync: token exists now
+        req.first_token_time = self._now()
+        req.state = RequestState.RUNNING
+        req.slot = slot
+        req.output_tokens.append(token)
+        self._slot_req[slot] = req
+        self._current[slot] = token
+        self._maybe_retire(req, token, finished)
+
+    def _maybe_retire(self, req: Request, token: int,
+                      finished: List[Request]) -> None:
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            req.finish_reason = "eos"
+        elif len(req.output_tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+        else:
+            return
+        req.state = RequestState.FINISHED
+        req.finish_time = self._now()
+        self.pool.release(req.slot)
+        del self._slot_req[req.slot]
+        self.metrics.record_finish(req)
+        finished.append(req)
+
+    # ------------------------------------------------------------------
+    def step(self) -> List[Request]:
+        """One scheduler iteration: admit into free slots, then one decode
+        step for every live slot. Returns the requests that finished."""
+        finished: List[Request] = []
+        for req in self.scheduler.grant(self.pool.free_count,
+                                        self.live_count):
+            self._admit(req, finished)
+        if self._slot_req:
+            eng = self.engine
+            tokens = jnp.asarray(self._current[:, None])
+            pos = jnp.asarray(self.pool.positions())
+            logits, cache = eng._jit_decode(eng.params, self.pool.cache,
+                                            tokens, pos)
+            self.pool.cache = cache
+            self.pool.bump()
+            nxt = self._sample(logits)
+            for slot, req in list(self._slot_req.items()):
+                token = int(nxt[slot])
+                req.output_tokens.append(token)
+                self._current[slot] = token
+                self._maybe_retire(req, token, finished)
+        return finished
+
+    def run_until_drained(self, max_steps: Optional[int] = None
+                          ) -> List[Request]:
+        """Step until the queue and every slot are empty (or ``max_steps``).
+        Every step with live work produces at least one token and every
+        request's budget is finite, so this terminates."""
+        out: List[Request] = []
+        steps = 0
+        while self.scheduler.pending or self._slot_req:
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    def stats(self) -> dict:
+        """Aggregate SLO snapshot (see ServingMetrics.snapshot)."""
+        return self.metrics.snapshot()

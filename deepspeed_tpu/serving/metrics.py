@@ -1,0 +1,84 @@
+"""Per-request SLO metrics, aggregated and emitted through the monitor.
+
+Every finished request contributes its derived latencies (TTFT, queue
+wait, per-token gap) to the aggregate; :meth:`ServingMetrics.snapshot`
+reduces them to the serving-SLO quantiles (p50/p99 TTFT, req/s,
+tokens/s) the benchmark row and dashboards report. When a
+:class:`~deepspeed_tpu.monitor.monitor.Monitor` is attached, each
+retirement writes ``serving/*`` events keyed by request id — the same
+``(tag, value, step)`` event path training metrics use, so the existing
+TensorBoard/W&B/CSV sinks pick serving traffic up with zero new
+plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .request import Request
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    return float(np.percentile(np.asarray(values), q)) if values else None
+
+
+class ServingMetrics:
+    """Accumulates finished/rejected requests; reduces to SLO aggregates."""
+
+    def __init__(self, monitor: Optional[Any] = None):
+        self.monitor = monitor
+        self.finished: List[Request] = []
+        self.rejected: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def record_rejection(self, req: Request) -> None:
+        reason = req.reject_reason or "unknown"
+        self.rejected[reason] = self.rejected.get(reason, 0) + 1
+
+    def record_finish(self, req: Request) -> None:
+        self.finished.append(req)
+        if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            self.monitor.write_events([
+                ("serving/ttft_ms", (req.ttft or 0.0) * 1e3, req.request_id),
+                ("serving/queue_wait_ms", (req.queue_wait or 0.0) * 1e3,
+                 req.request_id),
+                ("serving/per_token_ms", (req.per_token_latency or 0.0) * 1e3,
+                 req.request_id),
+                ("serving/new_tokens", float(len(req.output_tokens)),
+                 req.request_id),
+            ])
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Aggregate SLO view over everything finished so far.
+
+        ``requests_per_s`` spans first submit -> last finish: it charges
+        the server for queueing delay, which is the number a capacity
+        planner actually needs (completions per wall-second under the
+        offered load), not a best-case decode rate.
+        """
+        done = self.finished
+        ttfts = [r.ttft for r in done if r.ttft is not None]
+        waits = [r.queue_wait for r in done if r.queue_wait is not None]
+        gaps = [r.per_token_latency for r in done
+                if r.per_token_latency is not None]
+        new_tokens = sum(len(r.output_tokens) for r in done)
+        span = None
+        if done:
+            t0 = min(r.submit_time for r in done if r.submit_time is not None)
+            t1 = max(r.finish_time for r in done if r.finish_time is not None)
+            span = max(t1 - t0, 1e-9)
+        return {
+            "completed": len(done),
+            "rejected": dict(self.rejected),
+            "new_tokens": new_tokens,
+            "requests_per_s": (len(done) / span) if span else None,
+            "tokens_per_s": (new_tokens / span) if span else None,
+            "ttft_p50_ms": _pct([t * 1e3 for t in ttfts], 50),
+            "ttft_p99_ms": _pct([t * 1e3 for t in ttfts], 99),
+            "queue_wait_p50_ms": _pct([w * 1e3 for w in waits], 50),
+            "per_token_p50_ms": _pct([g * 1e3 for g in gaps], 50),
+            "per_token_p99_ms": _pct([g * 1e3 for g in gaps], 99),
+        }

@@ -1,0 +1,87 @@
+"""Request model for the serving subsystem.
+
+A :class:`Request` is one user generation job moving through the
+lifecycle ``QUEUED -> RUNNING -> FINISHED`` (or ``REJECTED`` straight
+out of admission control). The object doubles as the per-request SLO
+record: the scheduler stamps wall-clock times at each transition and the
+latency metrics (TTFT, queue wait, per-token latency) are derived
+properties, so there is exactly one place timing truth lives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional
+
+import numpy as np
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    REJECTED = "rejected"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its lifecycle/metric record.
+
+    ``output_tokens`` includes every sampled token (the EOS token too,
+    matching ``InferenceEngine.generate`` which returns the row through
+    its first EOS). Timing fields are ``time.perf_counter`` stamps set
+    by the serving engine; they are ``None`` until the corresponding
+    transition happens.
+    """
+
+    request_id: int
+    prompt: np.ndarray                      # (T,) int32
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+
+    state: RequestState = RequestState.QUEUED
+    reject_reason: Optional[str] = None     # "queue_full" | "prompt_too_long"
+    finish_reason: Optional[str] = None     # "eos" | "length"
+    slot: Optional[int] = None
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+
+    submit_time: Optional[float] = None
+    admit_time: Optional[float] = None      # prefill issued (slot granted)
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(np.shape(self.prompt)[0])
+
+    def tokens(self) -> np.ndarray:
+        """Prompt + generated tokens, the ``generate()``-shaped row."""
+        return np.concatenate(
+            [np.asarray(self.prompt, np.int32),
+             np.asarray(self.output_tokens, np.int32)])
+
+    # -- derived SLO metrics (seconds; None until the inputs exist) ----
+    @property
+    def queue_wait(self) -> Optional[float]:
+        if self.submit_time is None or self.admit_time is None:
+            return None
+        return self.admit_time - self.submit_time
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token: submit -> first sampled token."""
+        if self.submit_time is None or self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+    @property
+    def per_token_latency(self) -> Optional[float]:
+        """Mean decode latency per token AFTER the first (the steady-state
+        inter-token gap users see while a response streams)."""
+        if self.first_token_time is None or self.finish_time is None:
+            return None
+        n = len(self.output_tokens)
+        if n <= 1:
+            return 0.0
+        return (self.finish_time - self.first_token_time) / (n - 1)

@@ -1,0 +1,67 @@
+"""Admission queue + slot-grant policy for continuous batching.
+
+The scheduler is deliberately host-only and device-free: it owns the
+FIFO queue, enforces admission control (bounded queue depth,
+prompt-fits-in-capacity) and decides WHICH queued requests get a slot
+this step. Two policies:
+
+* ``"continuous"`` — iteration-level scheduling (Orca; PAPERS.md):
+  every step, any free slot is immediately refilled from the queue.
+  Retirements and admissions interleave with decode, so slots never
+  idle while work is queued.
+* ``"gang"`` — the static-batch discipline ``generate()`` imposes,
+  expressed in the same machinery: admit only when the pool is fully
+  drained, then seat a whole batch at once. This is the baseline arm of
+  the serving benchmark — same engine, same kernels, only the admission
+  policy differs — so the bench row isolates the scheduling win.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, List, Optional, Tuple
+
+from .request import Request, RequestState
+
+POLICIES = ("continuous", "gang")
+
+
+class FIFOScheduler:
+    """Bounded FIFO admission queue with a pluggable slot-grant policy."""
+
+    def __init__(self, num_slots: int, max_queue_depth: int = 64,
+                 policy: str = "continuous", capacity: Optional[int] = None):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r}; expected one of "
+                             f"{POLICIES}")
+        self.num_slots = num_slots
+        self.max_queue_depth = max_queue_depth
+        self.policy = policy
+        self.capacity = capacity
+        self.queue: Deque[Request] = collections.deque()
+
+    @property
+    def pending(self) -> int:
+        return len(self.queue)
+
+    # ------------------------------------------------------------------
+    def submit(self, req: Request) -> Tuple[bool, Optional[str]]:
+        """Admission control. Returns ``(accepted, reject_reason)``;
+        accepted requests join the FIFO queue."""
+        if self.capacity is not None and \
+                req.prompt_len + req.max_new_tokens > self.capacity:
+            return False, "prompt_too_long"
+        if len(self.queue) >= self.max_queue_depth:
+            return False, "queue_full"
+        req.state = RequestState.QUEUED
+        self.queue.append(req)
+        return True, None
+
+    def grant(self, free_slots: int, live_slots: int) -> List[Request]:
+        """Pop the requests that may take a slot this step."""
+        if self.policy == "gang" and live_slots > 0:
+            return []  # batch-synchronous: wait for the whole gang to drain
+        granted: List[Request] = []
+        while self.queue and len(granted) < free_slots:
+            granted.append(self.queue.popleft())
+        return granted

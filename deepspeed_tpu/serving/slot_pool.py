@@ -1,0 +1,110 @@
+"""Fixed-shape slot pool of per-slot KV cache.
+
+The pool owns ONE statically-shaped cache pytree in the exact layout the
+model's flax ``cache`` collection uses (``{"cache_store": {...}}`` with
+k/v ``(L, num_slots, KV, cache_d, max_seq_len)``), allocated through the
+module-declared :class:`~deepspeed_tpu.models.transformer_lm.KVCacheSpec`
+— batch dimension = slots. Continuous batching then never changes a
+shape: admitting, retiring and reusing slots are all data movement
+inside the same buffers, so the jitted decode step compiles once and is
+replayed for the server's lifetime (alive-masking: a retired slot is
+padding, its garbage writes and attention contributions are masked out
+by the per-slot ``index`` lengths, not by a recompile).
+
+Admission writes a single-sequence prefill cache into the slot's batch
+row with a dynamic-index update (slot id is a traced operand — one
+compile covers every slot). The prefill cache is allocated at full
+``max_seq_len`` by ``_CacheStore``, so the row write overwrites ALL of
+the retired occupant's stale state, scales and garbage included.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SlotPool:
+    """``num_slots`` independently-occupied rows of one shared KV cache."""
+
+    def __init__(self, spec: Any, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.spec = spec
+        self.num_slots = num_slots
+        self.capacity = int(spec.max_seq_len)
+        # the flax "cache" collection pytree the engine's decode consumes
+        self.cache: Dict[str, Any] = {"cache_store": spec.stacked_cache(num_slots)}
+        # host mirror of the per-slot cache index (device truth lives in
+        # cache["cache_store"]["index"]); decode needs the (B,) positions
+        # each step and reading them back from device would sync
+        self.starts = np.zeros((num_slots,), np.int32)
+        self._free = list(range(num_slots))
+        heapq.heapify(self._free)  # smallest slot first: deterministic layout
+        # donate the pool (updated in place in HBM); the (L, 1, ...)
+        # prefill cache is NOT donated — its shapes can never alias the
+        # (L, num_slots, ...) outputs, so donating it only warns
+        self._admit_jit = jax.jit(self._admit_row, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError("slot pool exhausted (scheduler bug: admit "
+                               "called without a free slot)")
+        return heapq.heappop(self._free)
+
+    def release(self, slot: int) -> None:
+        heapq.heappush(self._free, slot)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _admit_row(pool: dict, pre: dict, slot, length):
+        """Write the (L, 1, ...) prefill cache into batch row ``slot`` and
+        set that slot's index to the TRUE prompt length (the prefill ran
+        at a padded bucket width; attention masking and the next write
+        offset both key off ``index``, so right-padding stays invisible)."""
+
+        def write(dst, src):
+            idx = (jnp.zeros((), jnp.int32), jnp.asarray(slot, jnp.int32)) + \
+                (jnp.zeros((), jnp.int32),) * (dst.ndim - 2)
+            return jax.lax.dynamic_update_slice(dst, src.astype(dst.dtype), idx)
+
+        out = {k: write(pool[k], pre[k]) for k in pool if k != "index"}
+        out["index"] = pool["index"].at[jnp.asarray(slot, jnp.int32)].set(
+            jnp.asarray(length, jnp.int32))
+        return out
+
+    def admit(self, prefill_cache: dict, slot: int, length: int) -> None:
+        """Install a prefilled sequence into ``slot`` (alloc'd by caller)."""
+        if length > self.capacity:
+            raise ValueError(f"sequence length {length} exceeds slot "
+                             f"capacity {self.capacity}")
+        self.cache = {"cache_store": self._admit_jit(
+            self.cache["cache_store"], prefill_cache["cache_store"],
+            jnp.asarray(slot, jnp.int32), jnp.asarray(length, jnp.int32))}
+        self.starts[slot] = length
+
+    def bump(self) -> None:
+        """Advance the host start mirror after one decode step (the device
+        ``index`` was already advanced inside the jitted step — for every
+        slot, dead ones included; dead-slot writes land in masked
+        positions, i.e. padding, never a recompile)."""
+        self.starts += 1
+
+    def positions(self) -> np.ndarray:
+        """(num_slots,) decode positions, clamped into the allocation so
+        long-dead slots can't push position-embedding lookups or cache
+        writes past the last (masked) column."""
+        return np.minimum(self.starts, self.capacity - 1).astype(np.int32)

@@ -84,6 +84,35 @@ def test_transformer_lm_chunked_matches_dense(tied):
     _assert_tree_close(g_dense, g_chunk, rtol=2e-4, atol=2e-5)
 
 
+def test_chunked_int8_guard_is_untied_only():
+    """loss_chunk + int8-quantized head: the ValueError must fire ONLY
+    for an UNTIED int8 lm_head (QuantDense kernel the streaming loss
+    can't read); tied embeddings are never quantized and must pass."""
+    import dataclasses
+
+    from deepspeed_tpu.models.transformer_lm import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    base = TransformerConfig(vocab_size=64, max_seq_len=16, n_embd=32,
+                             n_layer=1, n_head=2, dtype=jnp.float32,
+                             loss_chunk=8, int8_weights=True, int8_head=True)
+    rng = np.random.default_rng(2)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, 64, (2, 16)).astype(np.int32))}
+
+    tied = TransformerLM(dataclasses.replace(base, tie_word_embeddings=True))
+    params = tied.init({"params": jax.random.PRNGKey(0)}, batch)["params"]
+    loss = tied.apply({"params": params}, batch)
+    assert np.isfinite(float(loss))
+
+    untied = TransformerLM(dataclasses.replace(base,
+                                               tie_word_embeddings=False))
+    with pytest.raises(ValueError, match="untied"):
+        untied.init({"params": jax.random.PRNGKey(0)}, batch)
+
+
 def test_chunked_xent_engine_trains():
     """The streaming loss composes with the full engine step (compiled
     train_batch, ZeRO-2): loss decreases."""

@@ -125,13 +125,22 @@ def test_pipeline_matches_sequential():
 
         @nn.compact
         def __call__(self, stacked, deterministic=True):
+            embed = ToyEmbed(name="embed")
+            blocks = [ToyBlock(name=f"b{i}") for i in range(self.n_blocks)]
+
             def one_micro(mb):
-                x = ToyEmbed(name="embed")(mb)
-                for i in range(self.n_blocks):
-                    x = ToyBlock(name=f"b{i}")(x)
+                x = embed(mb)
+                for block in blocks:
+                    x = block(x)
                 return _toy_loss(x, mb)
 
-            return jnp.mean(jax.vmap(one_micro)(stacked))
+            # unrolled per-micro (module calls inside jax.vmap trip flax's
+            # trace-level check; M is tiny and static)
+            M = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+            micro = lambda i: jax.tree_util.tree_map(  # noqa: E731
+                lambda x: x[i], stacked)
+            return jnp.mean(jnp.stack([one_micro(micro(i))
+                                       for i in range(M)]))
 
     # pipeline over 2 stages
     mesh = initialize_mesh(data=4, pipe=2)
@@ -243,6 +252,42 @@ def test_pipeline_tied_head_shares_params():
     post = [p for p in paths if "post_" in p]
     assert tied, paths
     assert not post, f"tied head created independent params: {post}"
+
+
+def test_pipeline_transformer_block_layerspec():
+    """The REAL TransformerBlock — signature (x, decode, deterministic,
+    kv_cache, block_hint), returning (x, new_cache) — must work as a
+    LayerSpec block: the executors detect the decode_det call mode and
+    unpack the tuple return."""
+    from deepspeed_tpu.models.transformer_lm import (TransformerBlock,
+                                                     TransformerConfig)
+
+    cfg = TransformerConfig(vocab_size=64, max_seq_len=16, n_embd=32,
+                            n_layer=4, n_head=4, dtype=jnp.float32)
+
+    class TokEmbed(nn.Module):
+        @nn.compact
+        def __call__(self, batch):
+            return nn.Embed(cfg.vocab_size, cfg.n_embd,
+                            name="tok")(batch["input_ids"])
+
+    def lm_loss(out, mb):
+        return jnp.mean((out.mean(axis=(-1, -2)) - mb["y"]) ** 2)
+
+    specs = tuple([LayerSpec(TokEmbed)]
+                  + [LayerSpec(TransformerBlock, cfg)] * 4)
+    mesh = initialize_mesh(data=4, pipe=2)
+    model = PipelineModule(layers=specs, loss_fn=lm_loss, num_stages=2)
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 100}, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, (16, 8)).astype(np.int32),
+             "y": rng.normal(size=(16,)).astype(np.float32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
+    assert np.all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
 
 
 def test_pipeline_eval_batch():
