@@ -11,6 +11,17 @@ continuous batching (deepspeed_tpu/serving/) vs the batch-synchronous
 only the admission policy differs. Reports req/s and p50/p99 TTFT for
 both arms; ``vs_baseline`` = continuous req/s over gang req/s.
 
+``python bench.py spec`` runs the speculative-decoding row: n-gram
+(prompt-lookup) draft + one fixed-shape ``verify_k`` forward vs plain
+one-token decode, same engine/slots/workload, on a repetitive-text
+workload. Reports tokens per slot-decode-step (plain pins this at
+exactly 1.0), draft acceptance rate and draft overhead, and checks the
+greedy outputs are bitwise identical between arms; ``vs_baseline`` =
+spec tokens/s over plain tokens/s (wall-clock).
+
+``--json <path>`` additionally writes the full result object to
+``<path>`` (e.g. ``BENCH_serving.json``) for dashboards/drivers.
+
 ``vs_baseline`` compares achieved model TFLOPS against the reference's
 headline single-device number: 64 TFLOPS/GPU for BERT-Large pretraining
 with DeepSpeed's fused kernels on V100-32GB (BASELINE.md row 1, reference
@@ -29,6 +40,17 @@ import time
 import numpy as np
 
 V5E_PEAK_TFLOPS = 197.0
+
+_JSON_PATH = None  # set by __main__ from --json <path>
+
+
+def _emit(result: dict) -> None:
+    """Print the one-line JSON row; mirror it to --json <path> if given."""
+    print(json.dumps(result))
+    if _JSON_PATH:
+        with open(_JSON_PATH, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
 
 
 def _enable_persistent_cache():
@@ -114,7 +136,7 @@ def main():
           for k, f in (("6n", f_6n), ("causal_attn", f_causal),
                        ("full_attn", f_full))}
 
-    print(json.dumps({
+    _emit({
         "metric": "GPT-2 350M seq1024 bf16 ZeRO-2 training throughput "
                   "(mbs10 x gas16, dots remat)",
         "value": round(tok_s_chip, 1),
@@ -135,7 +157,7 @@ def main():
                 100 * tf["full_attn"] / V5E_PEAK_TFLOPS, 1),
             "loss": float(loss),
         },
-    }))
+    })
 
 
 def serving_main():
@@ -217,9 +239,11 @@ def serving_main():
                 "ttft_p50_ms": round(s["ttft_p50_ms"], 1),
                 "ttft_p99_ms": round(s["ttft_p99_ms"], 1),
                 "per_token_p50_ms": round(s["per_token_p50_ms"], 2),
+                "tokens_per_decode_step": round(s["tokens_per_decode_step"],
+                                                3),
                 "completed": s["completed"]}
 
-    print(json.dumps({
+    _emit({
         "metric": f"continuous-batching serving, Poisson arrivals "
                   f"({n_req} req @ {rate}/s, {slots} slots, prompts "
                   f"{len_lo}-{len_hi}, budgets {gen_lo}-{gen_hi})",
@@ -234,13 +258,130 @@ def serving_main():
             "continuous": arm_detail(cont),
             "gang": arm_detail(gang),
         },
-    }))
+    })
+
+
+def spec_main():
+    """Speculative-decoding serving row: n-gram draft + verify_k vs plain
+    one-token decode — same engine, slots and workload; the only change
+    is the ``spec_decode`` block."""
+    import jax
+    import jax.numpy as jnp
+
+    _enable_persistent_cache()
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer_lm import (TransformerConfig,
+                                                     TransformerLM)
+    from deepspeed_tpu.serving import ServingEngine
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:  # keep the row runnable for local validation
+        cfg = TransformerConfig(vocab_size=512, max_seq_len=256, n_embd=64,
+                                n_layer=2, n_head=4, dtype=jnp.float32)
+        n_req, slots, k = 16, 4, 6
+        len_lo, len_hi, gen_lo, gen_hi = 16, 48, 32, 96
+    else:
+        cfg = TransformerConfig(vocab_size=50257, max_seq_len=1024,
+                                n_embd=768, n_layer=12, n_head=12,
+                                dtype=jnp.bfloat16)
+        n_req, slots, k = 32, 8, 8
+        len_lo, len_hi, gen_lo, gen_hi = 32, 128, 64, 224
+
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32),
+                        method=model.logits)["params"]
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype="fp32" if on_cpu else "bf16", mp_size=1)
+
+    gen = np.random.default_rng(0)
+    # repetitive-text workload — prompt-lookup drafting's home turf
+    # (summarization/code-edit/retrieval-style traffic that quotes its
+    # own context): each prompt tiles a short random motif
+    prompts, budgets = [], []
+    for _ in range(n_req):
+        T = int(gen.integers(len_lo, len_hi + 1))
+        motif = gen.integers(0, cfg.vocab_size,
+                             size=int(gen.integers(4, 9)))
+        prompts.append(np.tile(motif, T // len(motif) + 1)[:T]
+                       .astype(np.int32))
+        budgets.append(int(gen.integers(gen_lo, gen_hi + 1)))
+
+    spec_cfg = {"drafter": "ngram", "k": k, "max_ngram": 3}
+
+    def run_arm(spec):
+        srv = ServingEngine(engine, num_slots=slots, max_queue_depth=n_req,
+                            spec_decode=spec)
+        for p, b in zip(prompts, budgets):
+            srv.submit(p, max_new_tokens=b)
+        t0 = time.perf_counter()
+        done = srv.run_until_drained()
+        wall = time.perf_counter() - t0
+        s = srv.stats()
+        s["wall_s"] = wall
+        s["outputs"] = {r.request_id % n_req: list(r.output_tokens)
+                        for r in done}
+        return s
+
+    run_arm(None), run_arm(spec_cfg)       # warmup: compile both arms
+    plain = run_arm(None)
+    spec = run_arm(spec_cfg)
+
+    parity = plain["outputs"] == spec["outputs"]  # greedy: must be bitwise
+    tps_plain = plain["new_tokens"] / plain["wall_s"]
+    tps_spec = spec["new_tokens"] / spec["wall_s"]
+
+    _emit({
+        "metric": f"speculative decoding (ngram k={k}) on repetitive-text "
+                  f"serving ({n_req} req, {slots} slots, prompts "
+                  f"{len_lo}-{len_hi}, budgets {gen_lo}-{gen_hi})",
+        "value": round(spec["tokens_per_decode_step"], 3),
+        "unit": "tokens/slot-decode-step",
+        "vs_baseline": round(tps_spec / tps_plain, 3),
+        "detail": {
+            "baseline": "plain one-token decode, same engine/slots/"
+                        "workload (tokens_per_decode_step == 1.0 by "
+                        "construction)",
+            "greedy_parity": bool(parity),
+            "acceptance_rate": round(spec["spec_acceptance_rate"], 3)
+            if spec["spec_acceptance_rate"] is not None else None,
+            "draft_overhead_pct": round(spec["draft_overhead_pct"], 2)
+            if spec["draft_overhead_pct"] is not None else None,
+            "spec": {
+                "tokens_per_s": round(tps_spec, 1),
+                "tokens_per_decode_step": round(
+                    spec["tokens_per_decode_step"], 3),
+                "decode_steps": spec["decode_steps"],
+                "drafted": spec["spec_drafted"],
+                "accepted": spec["spec_accepted"],
+                "ttft_p50_ms": round(spec["ttft_p50_ms"], 1),
+                "ttft_p99_ms": round(spec["ttft_p99_ms"], 1),
+            },
+            "plain": {
+                "tokens_per_s": round(tps_plain, 1),
+                "tokens_per_decode_step": round(
+                    plain["tokens_per_decode_step"], 3),
+                "decode_steps": plain["decode_steps"],
+                "ttft_p50_ms": round(plain["ttft_p50_ms"], 1),
+                "ttft_p99_ms": round(plain["ttft_p99_ms"], 1),
+            },
+        },
+    })
 
 
 if __name__ == "__main__":
     import sys
 
-    entry = serving_main if "serving" in sys.argv[1:] else main
+    argv = sys.argv[1:]
+    if "--json" in argv:
+        _JSON_PATH = argv[argv.index("--json") + 1]
+    if "spec" in argv:
+        entry = spec_main
+    elif "serving" in argv:
+        entry = serving_main
+    else:
+        entry = main
     # the tunneled backend's remote-compile service intermittently 500s
     # (observed r3: "tpu_compile_helper subprocess exit code 1" for ~hours);
     # retry with backoff so a transient outage doesn't zero the round

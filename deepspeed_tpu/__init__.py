@@ -115,14 +115,30 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
                  num_slots: int = 4, max_queue_depth: int = 64, **kwargs):
     """Build a continuous-batching server: :func:`init_inference` for the
     engine, then wrap it in :class:`serving.ServingEngine` (slot-pooled KV
-    cache, FIFO admission, per-request SLO metrics). Serving-only knobs
-    (``policy``, ``do_sample``, ``temperature``, ``top_k``, ``top_p``,
-    ``seed``, ``monitor``) pass through to ServingEngine; everything else
-    configures the inference engine."""
+    cache, FIFO admission, per-request SLO metrics, optional speculative
+    decoding).
+
+    Knobs split into two scopes. **Server-global** (fixed at construction,
+    shared by every request — they shape the compiled programs): the
+    serving-only keys ``policy``, ``do_sample``, ``temperature``,
+    ``top_k``, ``top_p``, ``seed``, ``monitor`` and ``spec_decode``,
+    which pass through to ServingEngine, plus ``num_slots`` /
+    ``max_queue_depth``. **Per-request** (ride on each ``submit()``):
+    ``max_new_tokens`` and ``eos_token_id`` — nothing else varies per
+    request, so slot churn never changes a compiled shape. Everything
+    else configures the inference engine.
+
+    ``spec_decode`` enables draft–verify speculative decoding: ``True``
+    for defaults (n-gram drafter, k=4), a dict such as
+    ``{"drafter": "ngram", "k": 8, "max_ngram": 3}`` or
+    ``{"drafter": "model", "draft_engine": small_engine}``, or a
+    :class:`serving.SpecDecodeConfig`. Greedy output stays bitwise
+    identical to ``spec_decode=None``; admission control tightens to
+    ``prompt + max_new_tokens <= capacity - k`` (the verify headroom)."""
     from .serving.engine import ServingEngine
 
     serve_keys = ("policy", "do_sample", "temperature", "top_k", "top_p",
-                  "seed", "monitor")
+                  "seed", "monitor", "spec_decode")
     serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
     engine = init_inference(model=model, config=config, **kwargs)
     return ServingEngine(engine, num_slots=num_slots,

@@ -36,6 +36,29 @@ from ..utils.logging import log_dist
 from .config import DeepSpeedInferenceConfig
 
 
+def _filter_logits(last, temperature, top_k, top_p):
+    """Temperature/top-k/top-p filtering on raw fp32 logits (masked-out
+    entries at -1e30). Shared between the sampling path and speculative
+    verification (serving/spec_decode) — acceptance probabilities must be
+    computed under EXACTLY the distribution the sampler draws from.
+    ``last`` is (..., V); temperature traced, top_k/top_p static."""
+    V = last.shape[-1]
+    scaled = last / jnp.maximum(temperature, 1e-6)
+    top_k = min(top_k, V)
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    if top_p < 1.0:
+        sorted_ = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest prefix with mass >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_, cutoff_idx[..., None], axis=-1)
+        scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+    return scaled
+
+
 class InferenceEngine:
     """Construct via :func:`deepspeed_tpu.init_inference`."""
 
@@ -77,6 +100,8 @@ class InferenceEngine:
         self._jit_prefill_gen = None
         self._jit_decode_scan = None
         self._jit_sample = None
+        self._decode_fn = None
+        self._jit_verify_k = None
         self._decode_scan_execs = {}  # aval-keyed AOT decode executables
         self._cache = None
         self._cache_batch = None
@@ -270,20 +295,7 @@ class InferenceEngine:
 
         def sample_fn(logits, rng, temperature, top_k, top_p, greedy):
             last = logits[:, -1, :].astype(jnp.float32)
-            V = last.shape[-1]
-            scaled = last / jnp.maximum(temperature, 1e-6)
-            top_k = min(top_k, V)
-            if top_k > 0:
-                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-                scaled = jnp.where(scaled < kth, -1e30, scaled)
-            if top_p < 1.0:
-                sorted_ = jnp.sort(scaled, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                # smallest prefix with mass >= top_p
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1)
-                cutoff = jnp.take_along_axis(sorted_, cutoff_idx[:, None], axis=-1)
-                scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+            scaled = _filter_logits(last, temperature, top_k, top_p)
             sampled = jax.random.categorical(rng, scaled, axis=-1)
             return jnp.where(greedy, jnp.argmax(last, axis=-1), sampled)
 
@@ -306,6 +318,9 @@ class InferenceEngine:
                 body, (cache, token, pos, rng), None, length=n_steps)
             return cache, toks.T  # (B, n_steps)
 
+        # the traced decode body is kept for composition: the speculative
+        # verify program (serving/spec_decode) closes over it
+        self._decode_fn = decode_fn
         self._jit_logits = jax.jit(logits_fn)
         self._jit_prefill = jax.jit(prefill_fn)
         self._jit_prefill_gen = jax.jit(prefill_last_fn) \
@@ -422,6 +437,41 @@ class InferenceEngine:
         spec = self.kv_cache_spec()
         cap = getattr(spec, "max_seq_len", None)
         return int(cap) if cap is not None else None
+
+    # ------------------------------------------------------------------
+    def verify_k(self, cache, tokens, pos, draft, draft_len, rng,
+                 temperature, greedy, top_k: int, top_p: float):
+        """Speculative verification: score K draft positions for every
+        row in ONE fixed-shape chunked-decode forward and run acceptance
+        in the same compiled program (greedy accept-prefix, or lossless
+        rejection sampling under the serving sampler's filtered
+        distribution for ``do_sample``).
+
+        ``tokens`` is (B, K+1) int32 — [current_token, draft_0..K-1] per
+        row; ``pos`` (B,) int32 per-slot cache offsets; ``draft`` (B, K);
+        ``draft_len`` (B,) int32 in [0, K] (0 = plain decode for that
+        row: dead or non-speculating slots ride along masked). The cache
+        operand is donated (updated in place in HBM) and comes back with
+        all K+1 positions written for every row — the caller rolls back
+        rejected positions by per-slot ``index`` masking
+        (:meth:`SlotPool.advance`), never a reshape.
+
+        Returns ``(cache, out (B, K+1) int32, n_emit (B,) int32)``: row
+        ``i`` emits ``out[i, :n_emit[i]]`` — the accepted draft prefix
+        plus the bonus/correction token (always >= 1 per step).
+        """
+        if self._decode_fn is None:
+            raise ValueError("verify_k requires an LM module with a "
+                             "decode() method (build jits first)")
+        if self._jit_verify_k is None:
+            from ..serving.spec_decode.verify import make_verify_fn
+
+            self._jit_verify_k = jax.jit(
+                make_verify_fn(self._decode_fn, _filter_logits),
+                donate_argnums=(1,), static_argnums=(9, 10))
+        return self._jit_verify_k(self.params, cache, tokens, pos, draft,
+                                  draft_len, rng, temperature, greedy,
+                                  int(top_k), float(top_p))
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: Optional[float] = None,

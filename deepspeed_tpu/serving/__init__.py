@@ -5,8 +5,10 @@ whole-batch decoder into a request-level server: a FIFO admission queue
 (:mod:`.scheduler`), a fixed-shape slot pool of per-slot KV cache sized
 from the module's declared :func:`kv_cache_spec` (:mod:`.slot_pool`),
 iteration-level scheduling with per-request SLO metrics
-(:mod:`.engine`, :mod:`.metrics`). Entry point:
-``deepspeed_tpu.init_serving(...)`` or :class:`ServingEngine` directly.
+(:mod:`.engine`, :mod:`.metrics`), and optional draft–verify
+speculative decoding over the same fixed shapes (:mod:`.spec_decode`).
+Entry point: ``deepspeed_tpu.init_serving(...)`` or
+:class:`ServingEngine` directly.
 """
 
 from .engine import ServingEngine  # noqa: F401
@@ -14,6 +16,9 @@ from .metrics import ServingMetrics  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .scheduler import FIFOScheduler  # noqa: F401
 from .slot_pool import SlotPool  # noqa: F401
+from .spec_decode import (  # noqa: F401
+    Drafter, NGramDrafter, SmallModelDrafter, SpecDecodeConfig)
 
 __all__ = ["ServingEngine", "ServingMetrics", "Request", "RequestState",
-           "FIFOScheduler", "SlotPool"]
+           "FIFOScheduler", "SlotPool", "SpecDecodeConfig", "Drafter",
+           "NGramDrafter", "SmallModelDrafter"]

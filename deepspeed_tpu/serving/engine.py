@@ -18,6 +18,17 @@ masked padding. Prompt prefills are right-padded to power-of-two
 buckets and the true last position is projected via
 ``prefill_last(input_ids, last_pos)``, bounding prefill recompiles at
 log2(max_seq_len) for arbitrary prompt lengths.
+
+With a ``spec_decode`` config the decode step becomes draft–verify
+speculative decoding over the same fixed shapes: a host-side
+:class:`~deepspeed_tpu.serving.spec_decode.Drafter` proposes up to K
+tokens per live slot, one jitted ``verify_k`` forward scores all
+``(num_slots, K+1)`` positions at once, and each slot keeps its
+accepted prefix plus the target model's bonus/correction token — up to
+K+1 tokens per slot per step, bitwise identical to plain greedy decode.
+Rejected draft positions are rolled back by the per-slot cache ``index``
+(:meth:`SlotPool.advance`), never by reshaping, so speculation adds
+exactly one more compiled program regardless of churn.
 """
 
 from __future__ import annotations
@@ -53,7 +64,8 @@ class ServingEngine:
                  do_sample: bool = False,
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 seed: int = 0, monitor: Optional[Any] = None):
+                 seed: int = 0, monitor: Optional[Any] = None,
+                 spec_decode: Optional[Any] = None):
         self.engine = engine
         # materialize params + jits before sizing anything off the module
         engine._ensure_params(jnp.zeros((1, 2), jnp.int32))
@@ -69,9 +81,25 @@ class ServingEngine:
                 "input_ids, last_pos) for bucketed slot prefill")
         cfg = engine._config
         self.pool = SlotPool(spec, num_slots)
+        self._spec = None
+        self._drafter = None
+        sched_capacity = self.pool.capacity
+        if spec_decode is not None:
+            from .spec_decode import SpecDecodeConfig, make_drafter
+            sc = SpecDecodeConfig.from_value(spec_decode)
+            if sc is not None and sc.enabled:  # False / enabled=False: off
+                sc.validate(self.pool.capacity)
+                self._spec = sc
+                self._drafter = make_drafter(sc)
+                # verify writes k+1 positions past a slot's live offset
+                # (rejected tail = masked padding). Reserving k columns of
+                # headroom at admission keeps even a fully-rejected chunk
+                # inside the allocation, so the dynamic-slice writes can
+                # never clamp into another request's live columns.
+                sched_capacity = self.pool.capacity - sc.k
         self.scheduler = FIFOScheduler(num_slots, max_queue_depth,
                                        policy=policy,
-                                       capacity=self.pool.capacity)
+                                       capacity=sched_capacity)
         self.metrics = ServingMetrics(monitor)
         self.temperature = cfg.temperature if temperature is None else temperature
         self.top_k = cfg.top_k if top_k is None else top_k
@@ -132,21 +160,34 @@ class ServingEngine:
     def _admit(self, req: Request, finished: List[Request]) -> None:
         eng = self.engine
         slot = self.pool.alloc()
-        T = req.prompt_len
-        width = self._bucket(T, self.pool.capacity)
-        ids = np.zeros((1, width), np.int32)
-        ids[0, :T] = req.prompt
-        req.admit_time = self._now()
-        logits, pre_cache = eng._jit_prefill_at(
-            eng.params, jnp.asarray(ids), jnp.asarray(T - 1, jnp.int32))
-        self.pool.admit(pre_cache, slot, T)
-        token = int(self._sample(logits)[0])   # device sync: token exists now
-        req.first_token_time = self._now()
-        req.state = RequestState.RUNNING
-        req.slot = slot
-        req.output_tokens.append(token)
-        self._slot_req[slot] = req
-        self._current[slot] = token
+        try:
+            T = req.prompt_len
+            width = self._bucket(T, self.pool.capacity)
+            ids = np.zeros((1, width), np.int32)
+            ids[0, :T] = req.prompt
+            req.admit_time = self._now()
+            logits, pre_cache = eng._jit_prefill_at(
+                eng.params, jnp.asarray(ids), jnp.asarray(T - 1, jnp.int32))
+            self.pool.admit(pre_cache, slot, T)
+            token = int(self._sample(logits)[0])  # device sync: token exists
+            req.first_token_time = self._now()
+            req.slot = slot
+            self._slot_req[slot] = req
+            req.state = RequestState.RUNNING
+            req.output_tokens.append(token)
+            self._current[slot] = token
+        except Exception:
+            # undo the partial admission so the request can be re-queued
+            # with no trace: the slot goes back, timing/output state is
+            # reset, and _abort_step sees a clean QUEUED request
+            self._slot_req.pop(slot, None)
+            self.pool.release(slot)
+            req.state = RequestState.QUEUED
+            req.slot = None
+            req.admit_time = None
+            req.first_token_time = None
+            del req.output_tokens[:]
+            raise
         self._maybe_retire(req, token, finished)
 
     def _maybe_retire(self, req: Request, token: int,
@@ -167,26 +208,116 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def step(self) -> List[Request]:
         """One scheduler iteration: admit into free slots, then one decode
-        step for every live slot. Returns the requests that finished."""
+        (or draft+verify) step for every live slot. Returns the requests
+        that finished.
+
+        Exception-safe: if the engine throws mid-step, no slot leaks —
+        granted-but-unadmitted requests go back to the head of the queue,
+        requests whose KV state is unrecoverable are FAILED (reason
+        ``"error"``), the pool is reset, and the error propagates."""
         finished: List[Request] = []
-        for req in self.scheduler.grant(self.pool.free_count,
-                                        self.live_count):
-            self._admit(req, finished)
-        if self._slot_req:
-            eng = self.engine
-            tokens = jnp.asarray(self._current[:, None])
-            pos = jnp.asarray(self.pool.positions())
-            logits, cache = eng._jit_decode(eng.params, self.pool.cache,
-                                            tokens, pos)
-            self.pool.cache = cache
-            self.pool.bump()
-            nxt = self._sample(logits)
-            for slot, req in list(self._slot_req.items()):
-                token = int(nxt[slot])
+        granted = self.scheduler.grant(self.pool.free_count, self.live_count)
+        try:
+            for req in granted:
+                self._admit(req, finished)
+            if self._slot_req:
+                t0 = self._now()
+                if self._spec is not None:
+                    self._spec_decode_step(finished, t0)
+                else:
+                    self._decode_step(finished, t0)
+        except Exception:
+            self._abort_step(granted)
+            raise
+        return finished
+
+    def _decode_step(self, finished: List[Request], t0: float) -> None:
+        eng = self.engine
+        live = len(self._slot_req)
+        tokens = jnp.asarray(self._current[:, None])
+        pos = jnp.asarray(self.pool.positions())
+        logits, cache = eng._jit_decode(eng.params, self.pool.cache,
+                                        tokens, pos)
+        self.pool.cache = cache
+        self.pool.advance(1)
+        nxt = self._sample(logits)
+        emitted = 0
+        for slot, req in list(self._slot_req.items()):
+            token = int(nxt[slot])
+            req.output_tokens.append(token)
+            self._current[slot] = token
+            emitted += 1
+            self._maybe_retire(req, token, finished)
+        self.metrics.record_decode_step(emitted, live,
+                                        step_s=self._now() - t0)
+
+    def _spec_decode_step(self, finished: List[Request], t0: float) -> None:
+        """Draft K tokens per live slot, verify them all in ONE fixed-shape
+        (num_slots, K+1) forward, keep each slot's accepted prefix + bonus
+        token, and roll back rejected KV via the per-slot index."""
+        eng = self.engine
+        K = self._spec.k
+        B = self.pool.num_slots
+
+        histories: List[Optional[np.ndarray]] = [None] * B
+        for slot, req in self._slot_req.items():
+            histories[slot] = req.tokens()
+        draft, draft_len = self._drafter.propose(histories, K)
+        draft = np.asarray(draft, np.int32)
+        draft_len = np.clip(np.asarray(draft_len, np.int32), 0, K)
+        t_draft = self._now() - t0
+
+        tokens = np.concatenate([self._current[:, None], draft], axis=1)
+        self._rng, sub = jax.random.split(self._rng)
+        cache, out, n_emit = eng.verify_k(
+            self.pool.cache, jnp.asarray(tokens),
+            jnp.asarray(self.pool.positions()), jnp.asarray(draft),
+            jnp.asarray(draft_len), sub,
+            jnp.asarray(self.temperature, jnp.float32), self._greedy,
+            int(self.top_k), float(self.top_p))
+        self.pool.cache = cache
+        out = np.asarray(out)          # (B, K+1) emitted tokens per row
+        n_emit = np.asarray(n_emit)    # (B,) accepted drafts + 1
+
+        deltas = np.zeros((B,), np.int32)
+        emitted = drafted = accepted = 0
+        live = list(self._slot_req.items())
+        for slot, req in live:
+            e = int(n_emit[slot])
+            # the cache row holds e new positions regardless of how many
+            # tokens the request actually consumes below: if eos/budget
+            # truncates the emission, the request retires this step, so
+            # the surplus becomes dead padding in a freed slot
+            deltas[slot] = e
+            drafted += int(draft_len[slot])
+            accepted += e - 1
+            for token in out[slot, :e].tolist():
                 req.output_tokens.append(token)
                 self._current[slot] = token
+                emitted += 1
                 self._maybe_retire(req, token, finished)
-        return finished
+                if req.state is not RequestState.RUNNING:
+                    break
+        self.pool.advance(deltas)      # per-slot KV rollback
+        self.metrics.record_decode_step(emitted, len(live), drafted=drafted,
+                                        accepted=accepted, draft_s=t_draft,
+                                        step_s=self._now() - t0)
+
+    def _abort_step(self, granted: List[Request]) -> None:
+        """Mid-step exception recovery: never leak a slot. Requests the
+        failed _admit already rolled back to QUEUED re-join the queue
+        head; running requests lose their (possibly donated-away) KV
+        state and are FAILED; the pool restarts from a fresh cache."""
+        self.scheduler.requeue_front(
+            [r for r in granted if r.state is RequestState.QUEUED])
+        for req in self._slot_req.values():
+            req.state = RequestState.FAILED
+            req.finish_reason = "error"
+            req.finish_time = self._now()
+            self.metrics.record_failure(req)
+        self._slot_req.clear()
+        self._current[:] = 0
+        self.pool.reset()
 
     def run_until_drained(self, max_steps: Optional[int] = None
                           ) -> List[Request]:

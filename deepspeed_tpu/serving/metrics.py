@@ -31,11 +31,55 @@ class ServingMetrics:
         self.monitor = monitor
         self.finished: List[Request] = []
         self.rejected: Dict[str, int] = {}
+        self.failed: int = 0
+        # decode-step aggregates (speculative decoding efficiency):
+        # slot_steps counts (live slot, step) pairs so tokens/decode-step
+        # is per-slot — plain decode pins it at exactly 1.0 and any
+        # accepted draft pushes it above, regardless of batch occupancy
+        self.decode_steps: int = 0
+        self.decode_tokens: int = 0
+        self.slot_steps: int = 0
+        self.drafted: int = 0
+        self.accepted_drafts: int = 0
+        self.draft_time: float = 0.0
+        self.step_time: float = 0.0
 
     # ------------------------------------------------------------------
     def record_rejection(self, req: Request) -> None:
         reason = req.reject_reason or "unknown"
         self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            self.monitor.write_events([
+                (f"serving/rejected/{reason}", 1.0, req.request_id)])
+
+    def record_failure(self, req: Request) -> None:
+        """A running request killed by a mid-step engine exception."""
+        self.failed += 1
+        if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            self.monitor.write_events([
+                ("serving/failed", 1.0, req.request_id)])
+
+    def record_decode_step(self, emitted: int, live_slots: int,
+                           drafted: int = 0, accepted: int = 0,
+                           draft_s: float = 0.0, step_s: float = 0.0) -> None:
+        """One decode (or draft+verify) step: ``emitted`` tokens across
+        ``live_slots`` live slots; ``drafted``/``accepted`` count draft
+        proposals offered/accepted (0/0 when speculation is off)."""
+        self.decode_steps += 1
+        self.decode_tokens += emitted
+        self.slot_steps += live_slots
+        self.drafted += drafted
+        self.accepted_drafts += accepted
+        self.draft_time += draft_s
+        self.step_time += step_s
+        if drafted and self.monitor is not None and \
+                getattr(self.monitor, "enabled", True):
+            self.monitor.write_events([
+                ("serving/spec_acceptance", accepted / drafted,
+                 self.decode_steps),
+                ("serving/spec_tokens_per_slot_step",
+                 emitted / max(live_slots, 1), self.decode_steps),
+            ])
 
     def record_finish(self, req: Request) -> None:
         self.finished.append(req)
@@ -73,7 +117,20 @@ class ServingMetrics:
         return {
             "completed": len(done),
             "rejected": dict(self.rejected),
+            "failed": self.failed,
             "new_tokens": new_tokens,
+            "decode_steps": self.decode_steps,
+            "tokens_per_decode_step": (
+                self.decode_tokens / self.slot_steps
+                if self.slot_steps else None),
+            "spec_drafted": self.drafted,
+            "spec_accepted": self.accepted_drafts,
+            "spec_acceptance_rate": (
+                self.accepted_drafts / self.drafted
+                if self.drafted else None),
+            "draft_overhead_pct": (
+                100.0 * self.draft_time / self.step_time
+                if self.step_time > 0 else None),
             "requests_per_s": (len(done) / span) if span else None,
             "tokens_per_s": (new_tokens / span) if span else None,
             "ttft_p50_ms": _pct([t * 1e3 for t in ttfts], 50),

@@ -22,6 +22,7 @@ class RequestState(enum.Enum):
     RUNNING = "running"
     FINISHED = "finished"
     REJECTED = "rejected"
+    FAILED = "failed"       # aborted by a mid-step engine exception
 
 
 @dataclasses.dataclass
@@ -42,7 +43,7 @@ class Request:
 
     state: RequestState = RequestState.QUEUED
     reject_reason: Optional[str] = None     # "queue_full" | "prompt_too_long"
-    finish_reason: Optional[str] = None     # "eos" | "length"
+    finish_reason: Optional[str] = None     # "eos" | "length" | "error"
     slot: Optional[int] = None
     output_tokens: List[int] = dataclasses.field(default_factory=list)
 

@@ -57,6 +57,15 @@ class FIFOScheduler:
         self.queue.append(req)
         return True, None
 
+    def requeue_front(self, reqs: List[Request]) -> None:
+        """Put granted-but-never-admitted requests back at the HEAD of the
+        queue in their original order (step-abort recovery: they lost
+        nothing but their place in line, so they keep it). Bypasses
+        admission control — these requests already passed it."""
+        for r in reversed(reqs):
+            r.state = RequestState.QUEUED
+            self.queue.appendleft(r)
+
     def grant(self, free_slots: int, live_slots: int) -> List[Request]:
         """Pop the requests that may take a slot this step."""
         if self.policy == "gang" and live_slots > 0:

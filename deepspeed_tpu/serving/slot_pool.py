@@ -66,7 +66,27 @@ class SlotPool:
         return heapq.heappop(self._free)
 
     def release(self, slot: int) -> None:
+        """Return a slot to the free pool. Double-releasing corrupts the
+        free heap (the slot would be granted to TWO requests whose cache
+        rows then clobber each other), so it raises instead of silently
+        corrupting ``free_count``."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.num_slots})")
+        if slot in self._free:
+            raise RuntimeError(f"double release of slot {slot} (already "
+                               f"free; scheduler/engine bug)")
         heapq.heappush(self._free, slot)
+
+    def reset(self) -> None:
+        """Recovery path: free every slot and reallocate a zeroed device
+        cache. Used after a mid-step exception — a failed dispatch may
+        have consumed the donated cache buffers, so the old pytree can't
+        be trusted (or even alive) afterwards."""
+        self.cache = {"cache_store": self.spec.stacked_cache(self.num_slots)}
+        self.starts[:] = 0
+        self._free = list(range(self.num_slots))
+        heapq.heapify(self._free)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -96,12 +116,33 @@ class SlotPool:
             jnp.asarray(slot, jnp.int32), jnp.asarray(length, jnp.int32))}
         self.starts[slot] = length
 
-    def bump(self) -> None:
-        """Advance the host start mirror after one decode step (the device
-        ``index`` was already advanced inside the jitted step — for every
-        slot, dead ones included; dead-slot writes land in masked
-        positions, i.e. padding, never a recompile)."""
-        self.starts += 1
+    def advance(self, lengths) -> None:
+        """Advance the cache state machine after one decode/verify step.
+
+        * ``advance(1)`` (scalar) — the uniform plain-decode case: every
+          slot moved one position and the device ``index`` was ALREADY
+          advanced inside the jitted step (dead-slot writes land in
+          masked padding), so only the host mirror moves here.
+        * ``advance(lengths)`` ((num_slots,) array) — the speculative
+          case: slots accepted DIFFERENT numbers of tokens, while the
+          verify program advanced the device ``index`` uniformly by
+          K+1. The mirror advances per slot and the device ``index`` is
+          overwritten from it — this IS the KV rollback: rejected draft
+          positions beyond a slot's accepted length become masked
+          padding (invisible to attention, overwritten by the next
+          write) without reshaping or recompiling anything.
+        """
+        if np.ndim(lengths) == 0:
+            self.starts += int(lengths)
+            return
+        lengths = np.asarray(lengths, np.int32)
+        if lengths.shape != self.starts.shape:
+            raise ValueError(f"advance lengths shape {lengths.shape} != "
+                             f"({self.num_slots},)")
+        self.starts += lengths
+        cs = dict(self.cache["cache_store"])
+        cs["index"] = jnp.asarray(self.starts)
+        self.cache = {"cache_store": cs}
 
     def positions(self) -> np.ndarray:
         """(num_slots,) decode positions, clamped into the allocation so

@@ -1,0 +1,38 @@
+"""Speculative decoding over the fixed-shape slot pool.
+
+Draft-verify decode (Leviathan et al. 2023; Chen et al. 2023): a cheap
+drafter proposes up to K tokens per live slot, ONE fixed-shape
+verification forward scores all ``(num_slots, K+1)`` positions against
+the target model, and the longest draft prefix the target reproduces is
+accepted — up to K+1 tokens emitted per decode step, with greedy output
+bitwise identical to plain decoding (the corrected token at the first
+mismatch IS the token plain decode would have produced).
+
+The subsystem keeps the serving engine's zero-recompile shape
+discipline: verification always runs at batch = ``num_slots`` and width
+``K+1`` (dead / non-speculating slots ride along with ``draft_len`` 0,
+degrading gracefully to a plain decode step for that slot), and rejected
+draft positions are rolled back by per-slot ``index`` masking inside the
+same allocated KV buffers — never a reshape, never a new compile.
+
+Pieces:
+
+* :class:`~.config.SpecDecodeConfig` — the ``spec_decode`` block
+  accepted by ``ServingEngine`` / ``ds.init_serving``.
+* :class:`~.drafter.Drafter` — the pluggable proposal interface;
+  :class:`~.drafter.NGramDrafter` (prompt-lookup: suffix-match the
+  slot's own history, zero model cost) and
+  :class:`~.drafter.SmallModelDrafter` (any second ``InferenceEngine``
+  sharing the tokenizer).
+* :mod:`~.verify` — the pure verification/acceptance function jitted by
+  ``InferenceEngine.verify_k`` (greedy accept-prefix + rejection-
+  sampling accept for ``do_sample``).
+"""
+
+from .config import SpecDecodeConfig, make_drafter  # noqa: F401
+from .drafter import (Drafter, NGramDrafter,  # noqa: F401
+                      SmallModelDrafter)
+from .verify import make_verify_fn  # noqa: F401
+
+__all__ = ["SpecDecodeConfig", "make_drafter", "Drafter", "NGramDrafter",
+           "SmallModelDrafter", "make_verify_fn"]

@@ -213,6 +213,134 @@ def test_init_serving_wrapper(stack):
     assert req.state == RequestState.FINISHED
 
 
+def test_release_double_free_guard(stack):
+    """Releasing a freed (or out-of-range) slot raises instead of
+    silently corrupting the free heap into double-granting a slot."""
+    _, _, engine = stack
+    from deepspeed_tpu.serving import SlotPool
+    pool = SlotPool(engine.kv_cache_spec(), 2)
+    s = pool.alloc()
+    pool.release(s)
+    with pytest.raises(RuntimeError, match="double release"):
+        pool.release(s)
+    assert pool.free_count == 2  # the guard fired before corrupting
+    with pytest.raises(ValueError, match="range"):
+        pool.release(7)
+
+
+def test_midstep_decode_exception_never_leaks_slots(stack):
+    """An engine exception mid-decode must FAIL the running requests
+    (their donated KV state is unrecoverable), keep queued requests
+    queued, return every slot, and leave the server usable."""
+    _, _, engine = stack
+    rng = np.random.default_rng(41)
+    srv = ServingEngine(engine, num_slots=2, max_queue_depth=8)
+    r1 = srv.submit(rng.integers(0, 64, size=6).astype(np.int32),
+                    max_new_tokens=6)
+    r2 = srv.submit(rng.integers(0, 64, size=9).astype(np.int32),
+                    max_new_tokens=6)
+    r3 = srv.submit(rng.integers(0, 64, size=7).astype(np.int32),
+                    max_new_tokens=4)  # no free slot: stays QUEUED
+    srv.step()
+    assert r1.state == r2.state == RequestState.RUNNING
+
+    orig = engine._jit_decode
+    engine._jit_decode = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("injected decode failure"))
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            srv.step()
+    finally:
+        engine._jit_decode = orig
+
+    assert srv.live_count == 0 and srv.pool.free_count == 2
+    for r in (r1, r2):
+        assert r.state == RequestState.FAILED
+        assert r.finish_reason == "error" and r.finish_time is not None
+    assert r3.state == RequestState.QUEUED  # survives the abort
+
+    srv.run_until_drained(max_steps=50)    # server still works
+    assert r3.state == RequestState.FINISHED
+    expected = engine.generate(np.asarray(r3.prompt)[None],
+                               max_new_tokens=4)[0]
+    np.testing.assert_array_equal(r3.tokens(), expected)
+    assert srv.stats()["failed"] == 2
+
+
+def test_admit_exception_requeues_request(stack):
+    """A prefill exception during admission rolls the request back to
+    QUEUED (front of queue, state scrubbed) instead of leaking its slot
+    or failing it — it lost nothing but time."""
+    _, _, engine = stack
+    rng = np.random.default_rng(43)
+    srv = ServingEngine(engine, num_slots=2, max_queue_depth=8)
+    prompt = rng.integers(0, 64, size=6).astype(np.int32)
+    r1 = srv.submit(prompt, max_new_tokens=3)
+
+    orig = engine._jit_prefill_at
+    engine._jit_prefill_at = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("injected prefill failure"))
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            srv.step()
+    finally:
+        engine._jit_prefill_at = orig
+
+    assert r1.state == RequestState.QUEUED and srv.pending == 1
+    assert srv.pool.free_count == 2 and srv.live_count == 0
+    assert r1.slot is None and r1.output_tokens == []
+    assert r1.admit_time is None and r1.first_token_time is None
+
+    srv.run_until_drained(max_steps=50)
+    assert r1.state == RequestState.FINISHED
+    expected = engine.generate(prompt[None], max_new_tokens=3)[0]
+    np.testing.assert_array_equal(r1.tokens(), expected)
+    assert srv.stats()["failed"] == 0
+
+
+class _FakeMonitor:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, events):
+        self.events.extend(events)
+
+
+def test_rejection_paths_end_to_end_with_metrics(stack):
+    """queue_full / prompt_too_long shedding: the request never consumes
+    a slot, the reason lands in stats() AND as a monitor event, and the
+    accepted workload is unaffected."""
+    _, _, engine = stack
+    rng = np.random.default_rng(47)
+    mon = _FakeMonitor()
+    srv = ServingEngine(engine, num_slots=1, max_queue_depth=1, monitor=mon)
+
+    ok = srv.submit(rng.integers(0, 64, size=5).astype(np.int32),
+                    max_new_tokens=2)
+    full = srv.submit(rng.integers(0, 64, size=5).astype(np.int32),
+                      max_new_tokens=2)
+    long = srv.submit(rng.integers(0, 64, size=60).astype(np.int32),
+                      max_new_tokens=10)
+    assert full.state == RequestState.REJECTED
+    assert full.reject_reason == "queue_full"
+    assert long.state == RequestState.REJECTED
+    assert long.reject_reason == "prompt_too_long"
+    # shedding happened at submit: no slot was ever consumed
+    assert srv.pool.free_count == 1 and srv.live_count == 0
+    tags = [t for t, _, _ in mon.events]
+    assert tags.count("serving/rejected/queue_full") == 1
+    assert tags.count("serving/rejected/prompt_too_long") == 1
+
+    srv.run_until_drained(max_steps=20)
+    assert ok.state == RequestState.FINISHED
+    s = srv.stats()
+    assert s["completed"] == 1
+    assert s["rejected"] == {"queue_full": 1, "prompt_too_long": 1}
+    assert "serving/ttft_ms" in [t for t, _, _ in mon.events]
+
+
 def test_metrics_snapshot_fields(stack):
     _, _, engine = stack
     rng = np.random.default_rng(19)
@@ -228,3 +356,7 @@ def test_metrics_snapshot_fields(stack):
     for k in ("ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms",
               "per_token_p50_ms", "per_token_p99_ms"):
         assert np.isfinite(s[k]) and s[k] >= 0, k
+    # plain decode: exactly one token per live slot per step, no spec
+    assert s["tokens_per_decode_step"] == 1.0
+    assert s["failed"] == 0 and s["spec_drafted"] == 0
+    assert s["spec_acceptance_rate"] is None
