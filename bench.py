@@ -19,8 +19,24 @@ exactly 1.0), draft acceptance rate and draft overhead, and checks the
 greedy outputs are bitwise identical between arms; ``vs_baseline`` =
 spec tokens/s over plain tokens/s (wall-clock).
 
+``python bench.py serving-stall`` runs the stall-free admission row:
+chunked prefill interleaved with decode plus batched bucketed admission
+(``prefill_chunk > 0``) vs the PR-2 serial whole-prompt admission
+(``prefill_chunk=0``), SAME engine/kernels/slots/policy, only the
+admission path differs. The workload mixes short prompts with long ones
+whose serial prefill stalls every live decode slot (and, landing between
+power-of-two width buckets, pads to the next bucket in serial but only
+to the next chunk when chunked); reports TTFT p50/p99, per-token p99,
+p50/p99 inter-token step gap and req/s for both arms (median of 3
+interleaved replays), checks greedy outputs are bitwise identical across
+arms and replays and that the decode program did not recompile after
+warmup; ``vs_baseline`` = serial inter-token-gap p99 over stall-free
+inter-token-gap p99 (>1 means the streaming tail shrank).
+
 ``--json <path>`` additionally writes the full result object to
 ``<path>`` (e.g. ``BENCH_serving.json``) for dashboards/drivers.
+``check_regression.py`` diffs two such files and gates on named
+metrics.
 
 ``vs_baseline`` compares achieved model TFLOPS against the reference's
 headline single-device number: 64 TFLOPS/GPU for BERT-Large pretraining
@@ -261,6 +277,184 @@ def serving_main():
     })
 
 
+def serving_stall_main():
+    """Stall-free admission row: chunked+batched vs serial admission."""
+    import jax
+    import jax.numpy as jnp
+
+    _enable_persistent_cache()
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer_lm import (TransformerConfig,
+                                                     TransformerLM)
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:  # runnable locally, but heavy enough that a monolithic
+        # long-prompt prefill genuinely stalls concurrent decodes (the
+        # phenomenon this row measures needs prefill >> decode cost)
+        cfg = TransformerConfig(vocab_size=512, max_seq_len=1024, n_embd=128,
+                                n_layer=4, n_head=4, dtype=jnp.float32)
+        n_req, slots, rate, chunk = 64, 8, 120.0, 256
+        len_lo, len_hi, long_lo, long_hi = 17, 32, 520, 760
+        long_every, gen_lo, gen_hi = 8, 24, 32
+    else:
+        cfg = TransformerConfig(vocab_size=50257, max_seq_len=1024,
+                                n_embd=768, n_layer=12, n_head=12,
+                                dtype=jnp.bfloat16)
+        n_req, slots, rate, chunk = 64, 8, 48.0, 256
+        len_lo, len_hi, long_lo, long_hi = 32, 128, 520, 760
+        long_every, gen_lo, gen_hi = 8, 16, 96
+
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32),
+                        method=model.logits)["params"]
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype="fp32" if on_cpu else "bf16", mp_size=1)
+
+    gen = np.random.default_rng(0)
+    # one workload replayed identically into both arms: saturating
+    # Poisson arrivals, mostly short prompts (which batched admission
+    # coalesces into one dispatch where serial admission pays one
+    # full-width dispatch per request), plus a long prompt every
+    # ``long_every``-th request — the arrival whose serial prefill
+    # stalls every live slot for a whole monolithic dispatch. Under
+    # saturation TTFT is queue-drain-bound, so the arm that admits
+    # faster finishes faster and wins TTFT across the board.
+    arrivals = np.cumsum(gen.exponential(1.0 / rate, size=n_req))
+    prompts, budgets = [], []
+    for i in range(n_req):
+        if i % long_every == long_every - 1:
+            T = int(gen.integers(long_lo, long_hi + 1))
+        else:
+            T = int(gen.integers(len_lo, len_hi + 1))
+        prompts.append(gen.integers(0, cfg.vocab_size, size=T)
+                       .astype(np.int32))
+        budgets.append(int(gen.integers(gen_lo, gen_hi + 1)))
+
+    def warm_arm(srv: ServingEngine) -> None:
+        """Compile every program the timed replay can reach BEFORE timing:
+        each (batch-bucket x width-bucket) admission combination the
+        token budget allows (driven through real closed-loop admissions,
+        so the pool's jitted multi-row admit warms too), the chunk
+        program at several offsets, decode and sampling. Warm-by-replay
+        is NOT enough — admission grouping depends on wall-clock
+        arrival interleaving, so a grouping first seen mid-timed-run
+        would compile inside a timed step and masquerade as a stall."""
+        w = 16
+        top = 1
+        while top < len_hi:
+            top *= 2
+        while w <= top:
+            for count in range(1, slots + 1):
+                for _ in range(count):
+                    srv.submit(np.ones((w,), np.int32), max_new_tokens=2)
+                srv.run_until_drained()
+            w *= 2
+        srv.submit(np.ones((long_hi,), np.int32), max_new_tokens=2)
+        srv.run_until_drained()
+
+    def run_arm(srv: ServingEngine, timed: bool) -> dict:
+        if timed:  # fresh aggregates; warmup polluted them
+            srv.metrics = ServingMetrics(None)
+        reqs = []
+        t0 = time.perf_counter()
+        i = 0
+        while i < n_req or srv.pending or srv.live_count:
+            now = time.perf_counter() - t0
+            while i < n_req and arrivals[i] <= now:
+                reqs.append(srv.submit(prompts[i],
+                                       max_new_tokens=budgets[i]))
+                i += 1
+            if not (srv.pending or srv.live_count):
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+                continue
+            srv.step()
+        s = srv.stats()
+        s["outputs"] = [list(r.output_tokens) for r in reqs]
+        return s
+
+    # one engine per arm, reused warm->timed, so the timed pass replays
+    # fully-compiled programs (incl. this pool's jitted multi-row admit)
+    # budget = chunk + a full batch of shorts: bounds the per-step
+    # prefill stall without starving free slots while a long is chunking
+    arm_sf = ServingEngine(engine, num_slots=slots, max_queue_depth=n_req,
+                           prefill_chunk=chunk,
+                           prefill_token_budget=2 * chunk + 64 * slots)
+    arm_serial = ServingEngine(engine, num_slots=slots,
+                               max_queue_depth=n_req, prefill_chunk=0)
+    assert arm_sf._stall_free and not arm_serial._stall_free
+    warm_arm(arm_sf)
+    warm_arm(arm_serial)
+    n_decode_programs = engine._jit_decode._cache_size()
+
+    # interleaved replications with per-metric medians: single CPU
+    # replays jitter ~10% run-to-run, enough to flip a close verdict
+    reps = 3
+    sf_runs, serial_runs = [], []
+    for _ in range(reps):
+        sf_runs.append(run_arm(arm_sf, timed=True))
+        serial_runs.append(run_arm(arm_serial, timed=True))
+
+    decode_recompiles = engine._jit_decode._cache_size() - n_decode_programs
+    # greedy: outputs must be bitwise identical across arms AND reps
+    # (admission grouping varies with timing; results must not)
+    parity = all(r["outputs"] == serial_runs[0]["outputs"]
+                 for r in sf_runs + serial_runs)
+
+    _MED_KEYS = ("requests_per_s", "tokens_per_s", "ttft_p50_ms",
+                 "ttft_p99_ms", "per_token_p50_ms", "per_token_p99_ms",
+                 "step_gap_p50_ms", "step_gap_p99_ms", "stall_time_s")
+
+    def _median(runs):
+        out = dict(runs[-1])
+        for k in _MED_KEYS:
+            out[k] = float(np.median([r[k] for r in runs]))
+        return out
+
+    sf, serial = _median(sf_runs), _median(serial_runs)
+
+    def arm_detail(s):
+        return {"requests_per_s": round(s["requests_per_s"], 3),
+                "tokens_per_s": round(s["tokens_per_s"], 1),
+                "ttft_p50_ms": round(s["ttft_p50_ms"], 1),
+                "ttft_p99_ms": round(s["ttft_p99_ms"], 1),
+                "per_token_p50_ms": round(s["per_token_p50_ms"], 2),
+                "per_token_p99_ms": round(s["per_token_p99_ms"], 2),
+                "step_gap_p50_ms": round(s["step_gap_p50_ms"], 2),
+                "step_gap_p99_ms": round(s["step_gap_p99_ms"], 2),
+                "prefill_dispatches": s["prefill_dispatches"],
+                "stall_time_s": round(s["stall_time_s"], 4),
+                "completed": s["completed"]}
+
+    _emit({
+        "metric": f"stall-free serving admission (chunk {chunk}, "
+                  f"{n_req} req @ {rate}/s, {slots} slots, short "
+                  f"{len_lo}-{len_hi} / long {long_lo}-{long_hi} prompts): "
+                  f"p99 inter-token gap",
+        "value": round(sf["step_gap_p99_ms"], 2),
+        "unit": "ms (lower is better)",
+        "vs_baseline": round(serial["step_gap_p99_ms"] /
+                             max(sf["step_gap_p99_ms"], 1e-9), 3),
+        "detail": {
+            "baseline": "serial whole-prompt admission (prefill_chunk=0) "
+                        "at equal slots/policy — the PR-2 discipline on "
+                        "the same engine and kernels. vs_baseline is the "
+                        "serial arm's p99 inter-token gap over the "
+                        "stall-free arm's (>1: the tail shrank)",
+            "greedy_parity": bool(parity),
+            "decode_recompiles_after_warmup": int(decode_recompiles),
+            "replications": reps,
+            "ttft_p99_ratio": round(serial["ttft_p99_ms"] /
+                                    max(sf["ttft_p99_ms"], 1e-9), 3),
+            "stall_free": arm_detail(sf),
+            "serial": arm_detail(serial),
+        },
+    })
+
+
 def spec_main():
     """Speculative-decoding serving row: n-gram draft + verify_k vs plain
     one-token decode — same engine, slots and workload; the only change
@@ -376,7 +570,9 @@ if __name__ == "__main__":
     argv = sys.argv[1:]
     if "--json" in argv:
         _JSON_PATH = argv[argv.index("--json") + 1]
-    if "spec" in argv:
+    if "serving-stall" in argv:
+        entry = serving_stall_main
+    elif "spec" in argv:
         entry = spec_main
     elif "serving" in argv:
         entry = serving_main

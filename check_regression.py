@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Gate on benchmark regressions between two ``bench.py --json`` files.
+
+Usage:
+    python check_regression.py BASELINE.json CANDIDATE.json \
+        [--metric PATH[:higher|lower]] ... [--threshold 0.10]
+
+Each ``--metric`` names a dotted path into the result object (e.g.
+``value``, ``detail.stall_free.requests_per_s``) with an optional
+direction suffix: ``higher`` (default) means larger is better,
+``lower`` means smaller is better. With no ``--metric``, the headline
+``value:higher`` is checked.
+
+A metric regresses when the candidate is worse than the baseline by
+more than ``--threshold`` (default 0.10 = 10%), measured relative to
+the baseline. Improvements and within-threshold noise pass.
+
+Exit codes: 0 = all metrics within threshold, 1 = at least one
+regression, 2 = unusable input (missing file, bad JSON, missing metric,
+non-numeric value). The driver treats 1 as "block the PR" and 2 as
+"fix the invocation", so a typo'd metric name can never pass silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Tuple
+
+
+def _load(path: str) -> Any:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except OSError as e:
+        print(f"check_regression: cannot read {path}: {e}", file=sys.stderr)
+        sys.exit(2)
+    except ValueError as e:
+        print(f"check_regression: {path} is not valid JSON: {e}",
+              file=sys.stderr)
+        sys.exit(2)
+
+
+def _resolve(obj: Any, dotted: str, path: str) -> float:
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            print(f"check_regression: metric '{dotted}' not found in "
+                  f"{path} (missing key '{part}')", file=sys.stderr)
+            sys.exit(2)
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        print(f"check_regression: metric '{dotted}' in {path} is not a "
+              f"number: {cur!r}", file=sys.stderr)
+        sys.exit(2)
+    return float(cur)
+
+
+def _parse_metric(spec: str) -> Tuple[str, str]:
+    dotted, sep, direction = spec.partition(":")
+    if not sep:
+        return dotted, "higher"
+    if direction not in ("higher", "lower"):
+        print(f"check_regression: bad direction '{direction}' in "
+              f"'{spec}' (use 'higher' or 'lower')", file=sys.stderr)
+        sys.exit(2)
+    return dotted, direction
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Compare two bench.py --json files; exit 1 on "
+                    "regression beyond threshold.")
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--metric", action="append", default=[],
+                    metavar="PATH[:higher|lower]",
+                    help="dotted path into the JSON (repeatable); "
+                         "default: value:higher")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="max allowed relative regression (default 0.10)")
+    args = ap.parse_args(argv)
+
+    base = _load(args.baseline)
+    cand = _load(args.candidate)
+    specs = args.metric or ["value:higher"]
+
+    failed = False
+    for spec in specs:
+        dotted, direction = _parse_metric(spec)
+        b = _resolve(base, dotted, args.baseline)
+        c = _resolve(cand, dotted, args.candidate)
+        if b == 0:
+            # no meaningful relative delta; only direction flips count
+            delta = 0.0 if c == 0 else (1.0 if c > 0 else -1.0)
+        else:
+            delta = (c - b) / abs(b)
+        worse = delta < -args.threshold if direction == "higher" \
+            else delta > args.threshold
+        tag = "REGRESSION" if worse else "ok"
+        print(f"{tag:>10}  {dotted} ({direction}): "
+              f"baseline={b:g} candidate={c:g} delta={delta:+.1%}")
+        failed |= worse
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,9 +121,10 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
     Knobs split into two scopes. **Server-global** (fixed at construction,
     shared by every request — they shape the compiled programs): the
     serving-only keys ``policy``, ``do_sample``, ``temperature``,
-    ``top_k``, ``top_p``, ``seed``, ``monitor`` and ``spec_decode``,
-    which pass through to ServingEngine, plus ``num_slots`` /
-    ``max_queue_depth``. **Per-request** (ride on each ``submit()``):
+    ``top_k``, ``top_p``, ``seed``, ``monitor``, ``spec_decode``,
+    ``prefill_chunk`` and ``prefill_token_budget`` (stall-free chunked
+    admission; 0 disables), which pass through to ServingEngine, plus
+    ``num_slots`` / ``max_queue_depth``. **Per-request** (ride on each ``submit()``):
     ``max_new_tokens`` and ``eos_token_id`` — nothing else varies per
     request, so slot churn never changes a compiled shape. Everything
     else configures the inference engine.
@@ -138,7 +139,8 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
     from .serving.engine import ServingEngine
 
     serve_keys = ("policy", "do_sample", "temperature", "top_k", "top_p",
-                  "seed", "monitor", "spec_decode")
+                  "seed", "monitor", "spec_decode", "prefill_chunk",
+                  "prefill_token_budget")
     serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
     engine = init_inference(model=model, config=config, **kwargs)
     return ServingEngine(engine, num_slots=num_slots,

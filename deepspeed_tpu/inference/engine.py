@@ -102,6 +102,7 @@ class InferenceEngine:
         self._jit_sample = None
         self._decode_fn = None
         self._jit_verify_k = None
+        self._jit_prefill_chunk = None
         self._decode_scan_execs = {}  # aval-keyed AOT decode executables
         self._cache = None
         self._cache_batch = None
@@ -287,6 +288,43 @@ class InferenceEngine:
                 method=prefill_gen, mutable=["cache"])
             return out, vars_["cache"]
 
+        chunk_gen = getattr(module, "prefill_chunk", None)
+
+        def prefill_chunk_fn(params, cache, ids, slot, start, length,
+                             last_idx):
+            """One bounded prefill chunk DIRECTLY into slot ``slot`` of
+            the slot-pooled cache: dynamic-slice the target row out
+            (batch axis 1 of the (L, B, ...) leaves), run the (1, C)
+            chunked forward against it at offset ``start``, and
+            dynamic-update-slice the row back with the slot's index set
+            to ``start + length`` (the TRUE new prefill offset — the
+            chunk ran at padded width C). Only the target row is ever
+            written, so live neighbours can't be clobbered by the
+            chunk's C-wide writes, and slot/start/length are traced —
+            ONE compiled program covers every slot at every offset."""
+            cs = cache["cache_store"]
+            slot = jnp.asarray(slot, jnp.int32)
+            start = jnp.asarray(start, jnp.int32)
+            row = {k: jax.lax.dynamic_slice_in_dim(v, slot, 1, 1)
+                   for k, v in cs.items() if k != "index"}
+            row["index"] = start[None]
+            out, vars_ = module.apply(
+                {"params": dequant(params), "cache": {"cache_store": row}},
+                ids, start[None], last_idx, method=chunk_gen,
+                mutable=["cache"])
+            new = vars_["cache"]["cache_store"]
+
+            def write(dst, src):
+                idx = (jnp.zeros((), jnp.int32), slot) + \
+                    (jnp.zeros((), jnp.int32),) * (dst.ndim - 2)
+                return jax.lax.dynamic_update_slice(
+                    dst, src.astype(dst.dtype), idx)
+
+            merged = {k: write(cs[k], new[k]) for k in cs if k != "index"}
+            merged["index"] = cs["index"].at[slot].set(
+                start + jnp.asarray(length, jnp.int32))
+            return out, {"cache_store": merged}
+
         def decode_fn(params, cache, token, pos):
             out, vars_ = module.apply(
                 {"params": dequant(params), "cache": cache}, token, pos,
@@ -327,6 +365,9 @@ class InferenceEngine:
             if prefill_gen is not None else self._jit_prefill
         self._jit_prefill_at = jax.jit(prefill_at_fn) \
             if prefill_gen is not None else None
+        self._jit_prefill_chunk = jax.jit(prefill_chunk_fn,
+                                          donate_argnums=(1,)) \
+            if chunk_gen is not None else None
         self._jit_decode = jax.jit(decode_fn, donate_argnums=(1,))
         self._jit_sample = jax.jit(sample_fn, static_argnums=(3, 4))
         self._jit_decode_scan = jax.jit(decode_scan_fn,
@@ -439,6 +480,27 @@ class InferenceEngine:
         return int(cap) if cap is not None else None
 
     # ------------------------------------------------------------------
+    def prefill_chunk(self, cache, input_ids, slot, start, length,
+                      last_idx):
+        """Process one fixed-width prefill chunk into row ``slot`` of the
+        slot-pooled ``cache`` at offset ``start`` (see the jitted body in
+        ``_build_jits``). ``input_ids`` is (1, C) int32 right-padded,
+        ``length`` the TRUE token count in the chunk, ``last_idx`` the
+        position (within the chunk) to project — only meaningful on the
+        final chunk, whose logits seed the first sampled token. Returns
+        ``(logits (1, 1, V), cache)``; the cache operand is donated
+        (updated in place in HBM) and comes back with the slot's index
+        at ``start + length``."""
+        if self._jit_prefill_chunk is None:
+            raise ValueError("prefill_chunk requires a module exposing "
+                             "prefill_chunk(input_ids, start_pos, "
+                             "last_idx); the unified TransformerLM "
+                             "family does")
+        return self._jit_prefill_chunk(
+            self.params, cache, jnp.asarray(input_ids, jnp.int32),
+            jnp.asarray(slot, jnp.int32), jnp.asarray(start, jnp.int32),
+            jnp.asarray(length, jnp.int32), jnp.asarray(last_idx, jnp.int32))
+
     def verify_k(self, cache, tokens, pos, draft, draft_len, rng,
                  temperature, greedy, top_k: int, top_p: float):
         """Speculative verification: score K draft positions for every

@@ -805,6 +805,34 @@ class TransformerLM(nn.Module):
                 xb, i, 1, 0))(x, idx)
         return self._project_head(x)
 
+    def prefill_chunk(self, input_ids, start_pos, last_idx):
+        """Chunked serving prefill: process a fixed-width (B, C) token
+        chunk AGAINST the allocated cache at per-slot offsets and project
+        only ``last_idx`` onto the vocabulary, returning (B, 1, V).
+
+        This is ``decode``'s multi-token path (window-masked attention
+        over the allocated cache — row ``t`` of the chunk sees cache
+        positions ``[0, start + t]``, which IS the causal mask against
+        already-written positions), with ``prefill_last``'s head
+        discipline (one projected position instead of the (B, C, V)
+        logits tensor). Long prompts stream through it C tokens at a
+        time, so per-step serving latency is bounded by the chunk width
+        instead of the longest queued prompt (Sarathi-style stall-free
+        chunked prefill; PAPERS.md). Call with ``mutable=["cache"]``;
+        ``start_pos`` is scalar or (B,) — the serving path passes the
+        slot's current prefill offset. Right-padding in the final
+        partial chunk writes masked garbage past the true length
+        (invisible to attention once the caller sets the slot index to
+        the true length, exactly like the bucketed ``prefill_last``)."""
+        B, T = input_ids.shape
+        off = start_pos[:, None] if jnp.ndim(start_pos) == 1 else start_pos
+        pos = off + jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        x = self._transform(input_ids, pos, True, True, head=False)
+        idx = jnp.broadcast_to(jnp.asarray(last_idx, jnp.int32), (B,))
+        x = jax.vmap(lambda xb, i: jax.lax.dynamic_slice_in_dim(
+            xb, i, 1, 0))(x, idx)
+        return self._project_head(x)
+
     def decode(self, input_ids, start_pos, block_hint=None):
         """One (or few) token step against the cache; ``start_pos`` is the
         current cache length — scalar for a B-uniform batch, or (B,) for

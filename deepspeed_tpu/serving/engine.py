@@ -19,6 +19,24 @@ buckets and the true last position is projected via
 ``prefill_last(input_ids, last_pos)``, bounding prefill recompiles at
 log2(max_seq_len) for arbitrary prompt lengths.
 
+Admission is STALL-FREE by default (``prefill_chunk > 0``,
+Sarathi-style; PAPERS.md): each step spends at most a prefill token
+budget before the decode dispatch, so a burst of arrivals can no
+longer stall every live slot behind an unbounded prefill wave.
+Prompts longer than the chunk width are seated ``PREFILLING`` and
+stream into their slot's cache row one bounded
+``prefill_chunk(input_ids, start_pos, last_idx)`` dispatch per step
+(window-masked attention against the already-written positions — the
+jitted program slices the target row out and writes only it back, so
+live neighbours are untouched); shorter prompts waiting at the same
+bucket width are prefilled in ONE batched dispatch (batch dim bucketed
+to powers of two) and scattered into their slots by a single jitted
+multi-row admit. Compile count stays bounded by
+log2(num_slots) x log2(max_seq_len) admission programs plus one chunk
+program; greedy outputs remain bitwise identical to serial admission.
+``prefill_chunk=0`` restores the serial one-prompt-per-dispatch
+admission (the benchmark's baseline arm).
+
 With a ``spec_decode`` config the decode step becomes draft–verify
 speculative decoding over the same fixed shapes: a host-side
 :class:`~deepspeed_tpu.serving.spec_decode.Drafter` proposes up to K
@@ -65,7 +83,9 @@ class ServingEngine:
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  seed: int = 0, monitor: Optional[Any] = None,
-                 spec_decode: Optional[Any] = None):
+                 spec_decode: Optional[Any] = None,
+                 prefill_chunk: int = 64,
+                 prefill_token_budget: Optional[int] = None):
         self.engine = engine
         # materialize params + jits before sizing anything off the module
         engine._ensure_params(jnp.zeros((1, 2), jnp.int32))
@@ -101,6 +121,36 @@ class ServingEngine:
                                        policy=policy,
                                        capacity=sched_capacity)
         self.metrics = ServingMetrics(monitor)
+        # -- stall-free admission config -------------------------------
+        if prefill_chunk < 0:
+            raise ValueError(f"prefill_chunk must be >= 0, got "
+                             f"{prefill_chunk}")
+        chunk = min(int(prefill_chunk), self.pool.capacity)
+        # chunk starts are multiples of the chunk width, so requiring
+        # capacity % chunk == 0 guarantees start + chunk <= capacity for
+        # every chunk — the row's dynamic-update-slice can never clamp
+        # and smear the final columns. Auto-halve rather than error:
+        # chunk width is a latency knob, not a correctness contract.
+        while chunk > 1 and self.pool.capacity % chunk != 0:
+            chunk //= 2
+        self._stall_free = (chunk > 0 and policy == "continuous" and
+                            getattr(engine, "_jit_prefill_chunk", None)
+                            is not None)
+        self.prefill_chunk = chunk if self._stall_free else 0
+        if self._stall_free:
+            budget = (2 * chunk if prefill_token_budget is None
+                      else int(prefill_token_budget))
+            if budget < chunk:
+                raise ValueError(
+                    f"prefill_token_budget ({budget}) must be >= "
+                    f"prefill_chunk ({chunk}); a smaller budget could "
+                    f"never schedule the in-flight chunk")
+            self.prefill_token_budget = budget
+        else:
+            self.prefill_token_budget = None
+        # FIFO of seated PREFILLING requests whose prompts are still
+        # streaming in chunk by chunk; step() advances the head only
+        self._prefill_queue: List[Request] = []
         self.temperature = cfg.temperature if temperature is None else temperature
         self.top_k = cfg.top_k if top_k is None else top_k
         self.top_p = cfg.top_p if top_p is None else top_p
@@ -112,7 +162,9 @@ class ServingEngine:
         self._now = time.perf_counter
         log_dist(f"ServingEngine: slots={num_slots} policy={policy} "
                  f"capacity={self.pool.capacity} "
-                 f"max_queue_depth={max_queue_depth}", ranks=[0])
+                 f"max_queue_depth={max_queue_depth} "
+                 f"admission={'stall-free chunk=%d budget=%d' % (self.prefill_chunk, self.prefill_token_budget) if self._stall_free else 'serial'}",
+                 ranks=[0])
 
     # ------------------------------------------------------------------
     @property
@@ -165,12 +217,16 @@ class ServingEngine:
             width = self._bucket(T, self.pool.capacity)
             ids = np.zeros((1, width), np.int32)
             ids[0, :T] = req.prompt
+            running_before = self._running_count()
             req.admit_time = self._now()
             logits, pre_cache = eng._jit_prefill_at(
                 eng.params, jnp.asarray(ids), jnp.asarray(T - 1, jnp.int32))
             self.pool.admit(pre_cache, slot, T)
             token = int(self._sample(logits)[0])  # device sync: token exists
             req.first_token_time = self._now()
+            self.metrics.record_prefill(T, req.first_token_time -
+                                        req.admit_time,
+                                        blocking=running_before > 0)
             req.slot = slot
             self._slot_req[slot] = req
             req.state = RequestState.RUNNING
@@ -190,12 +246,161 @@ class ServingEngine:
             raise
         self._maybe_retire(req, token, finished)
 
+    def _running_count(self) -> int:
+        return sum(1 for r in self._slot_req.values()
+                   if r.state is RequestState.RUNNING)
+
+    def _admission_cost(self, req: Request) -> int:
+        """Prefill tokens this grant charges against the step budget: the
+        padded bucket width for a whole-prompt admission, one chunk for a
+        long prompt (only its first chunk can run this step)."""
+        T = req.prompt_len
+        if T <= self.prefill_chunk:
+            return self._bucket(T, self.pool.capacity)
+        return self.prefill_chunk
+
+    def _admit_stall_free(self, granted: List[Request],
+                          finished: List[Request]) -> None:
+        """Seat every granted request: long prompts become PREFILLING
+        (their cache rows fill chunk by chunk in later steps), short
+        prompts are grouped by padded bucket width and each group is
+        prefilled + scattered in ONE batched dispatch."""
+        groups: dict = {}
+        for req in granted:
+            T = req.prompt_len
+            if T > self.prefill_chunk:
+                slot = self.pool.alloc()
+                self.pool.reset_row(slot)
+                req.admit_time = self._now()
+                req.slot = slot
+                req.prefill_pos = 0
+                req.state = RequestState.PREFILLING
+                self._slot_req[slot] = req
+                self._prefill_queue.append(req)
+            else:
+                groups.setdefault(self._bucket(T, self.pool.capacity),
+                                  []).append(req)
+        for width in sorted(groups):
+            group = groups[width]
+            if len(group) == 1:
+                # singleton: the per-request path (no sentinel padding,
+                # no scatter program) is strictly cheaper — the batched
+                # dispatch only pays off when it coalesces ≥2 prompts
+                self._admit(group[0], finished)
+            else:
+                self._admit_batch(group, width, finished)
+
+    def _admit_batch(self, group: List[Request], width: int,
+                     finished: List[Request]) -> None:
+        """Batched bucketed admission: ``len(group)`` same-bucket prompts
+        prefilled in one ``prefill_last`` dispatch at a power-of-two
+        batch, then scattered into their slots by one jitted multi-row
+        admit. Compile count: log2(num_slots) batch buckets x
+        log2(max_seq_len) width buckets. Padding rows carry the slot
+        sentinel ``num_slots`` (scatter drop-mode discards them)."""
+        eng = self.engine
+        n = len(group)
+        nB = 1
+        while nB < n:
+            nB *= 2
+        ids = np.zeros((nB, width), np.int32)
+        last_pos = np.zeros((nB,), np.int32)
+        slots = np.full((nB,), self.pool.num_slots, np.int32)
+        lengths = np.zeros((nB,), np.int32)
+        running_before = self._running_count()
+        try:
+            for i, req in enumerate(group):
+                T = req.prompt_len
+                ids[i, :T] = req.prompt
+                last_pos[i] = T - 1
+                slots[i] = self.pool.alloc()
+                lengths[i] = T
+                req.admit_time = self._now()
+            t0 = self._now()
+            logits, pre_cache = eng._jit_prefill_at(
+                eng.params, jnp.asarray(ids), jnp.asarray(last_pos))
+            self.pool.admit_rows(pre_cache, slots, lengths)
+            tokens = self._sample(logits)  # device sync: tokens exist
+            now = self._now()
+            self.metrics.record_prefill(int(lengths.sum()), now - t0,
+                                        blocking=running_before > 0)
+            for i, req in enumerate(group):
+                token = int(tokens[i])
+                slot = int(slots[i])
+                req.first_token_time = now
+                req.slot = slot
+                self._slot_req[slot] = req
+                req.state = RequestState.RUNNING
+                req.output_tokens.append(token)
+                self._current[slot] = token
+                self._maybe_retire(req, token, finished)
+        except Exception:
+            # roll the whole group back to clean QUEUED requests so
+            # _abort_step re-queues them with no trace
+            for i, req in enumerate(group):
+                slot = int(slots[i])
+                if slot < self.pool.num_slots:
+                    self._slot_req.pop(slot, None)
+                    self.pool.release(slot)
+                req.state = RequestState.QUEUED
+                req.slot = None
+                req.admit_time = None
+                req.first_token_time = None
+                del req.output_tokens[:]
+            raise
+
+    def _prefill_chunk_step(self, finished: List[Request]) -> None:
+        """Run AT MOST one bounded prefill chunk — for the head of the
+        prefill queue — so per-step latency stays bounded by the token
+        budget no matter how long the queued prompts are. The final
+        chunk projects the prompt's true last position, samples the
+        first token, and flips the request to RUNNING."""
+        if not self._prefill_queue:
+            return
+        req = self._prefill_queue[0]
+        slot = req.slot
+        C = self.prefill_chunk
+        pos = req.prefill_pos
+        L = min(C, req.prompt_len - pos)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :L] = np.asarray(req.prompt, np.int32)[pos:pos + L]
+        running_before = self._running_count()
+        t0 = self._now()
+        logits, cache = self.engine.prefill_chunk(
+            self.pool.cache, ids, slot, pos, L, L - 1)
+        self.pool.cache = cache
+        self.pool.starts[slot] = pos + L  # device index moved in-program
+        req.prefill_pos = pos + L
+        if req.prefill_pos >= req.prompt_len:
+            token = int(self._sample(logits)[0])  # device sync
+            now = self._now()
+            self.metrics.record_prefill(L, now - t0,
+                                        blocking=running_before > 0)
+            self._prefill_queue.pop(0)
+            req.first_token_time = now
+            req.state = RequestState.RUNNING
+            req.output_tokens.append(token)
+            self._current[slot] = token
+            self._maybe_retire(req, token, finished)
+        else:
+            # no sync: the chunk is enqueued and this step's decode
+            # dispatch overlaps its host-side latency — the device
+            # serializes them anyway, and step_gap captures the real
+            # wall cost. Recorded time is therefore enqueue-side only.
+            self.metrics.record_prefill(L, self._now() - t0,
+                                        blocking=running_before > 0)
+
     def _maybe_retire(self, req: Request, token: int,
                       finished: List[Request]) -> None:
         if req.eos_token_id is not None and token == req.eos_token_id:
             req.finish_reason = "eos"
         elif len(req.output_tokens) >= req.max_new_tokens:
             req.finish_reason = "length"
+        elif req.slot is not None and \
+                int(self.pool.starts[req.slot]) >= self.pool.capacity:
+            # the slot's cache row is full: retire rather than silently
+            # clamp-overwrite the last column on the next decode write
+            req.finish_reason = "length_cap"
         else:
             return
         req.state = RequestState.FINISHED
@@ -216,11 +421,27 @@ class ServingEngine:
         requests whose KV state is unrecoverable are FAILED (reason
         ``"error"``), the pool is reset, and the error propagates."""
         finished: List[Request] = []
-        granted = self.scheduler.grant(self.pool.free_count, self.live_count)
+        t_step = self._now()
+        running_at_entry = self._running_count()
+        if self._stall_free:
+            # one chunk for the prefill-queue head will run this step;
+            # pre-charge it so admissions + chunk stay within budget
+            spent = self.prefill_chunk if self._prefill_queue else 0
+            granted = self.scheduler.grant(
+                self.pool.free_count, self.live_count,
+                token_budget=self.prefill_token_budget,
+                cost=self._admission_cost, spent=spent)
+        else:
+            granted = self.scheduler.grant(self.pool.free_count,
+                                           self.live_count)
         try:
-            for req in granted:
-                self._admit(req, finished)
-            if self._slot_req:
+            if self._stall_free:
+                self._admit_stall_free(granted, finished)
+                self._prefill_chunk_step(finished)
+            else:
+                for req in granted:
+                    self._admit(req, finished)
+            if self._running_count():
                 t0 = self._now()
                 if self._spec is not None:
                     self._spec_decode_step(finished, t0)
@@ -229,26 +450,42 @@ class ServingEngine:
         except Exception:
             self._abort_step(granted)
             raise
+        if running_at_entry:
+            # a running request waited through this WHOLE step for its
+            # next token — the user-visible inter-token gap, admission
+            # work included (what stall-free admission bounds)
+            self.metrics.record_step_gap(self._now() - t_step)
         return finished
 
     def _decode_step(self, finished: List[Request], t0: float) -> None:
         eng = self.engine
-        live = len(self._slot_req)
+        running = [(slot, req) for slot, req in self._slot_req.items()
+                   if req.state is RequestState.RUNNING]
         tokens = jnp.asarray(self._current[:, None])
         pos = jnp.asarray(self.pool.positions())
         logits, cache = eng._jit_decode(eng.params, self.pool.cache,
                                         tokens, pos)
         self.pool.cache = cache
-        self.pool.advance(1)
+        if self._prefill_queue:
+            # PREFILLING slots rode along as masked padding: the decode
+            # program advanced every device index by 1, so overwrite from
+            # the mirror (running rows +1, prefilling rows unchanged) —
+            # same index-rollback trick speculative decoding uses
+            deltas = np.zeros((self.pool.num_slots,), np.int32)
+            for slot, _ in running:
+                deltas[slot] = 1
+            self.pool.advance(deltas)
+        else:
+            self.pool.advance(1)
         nxt = self._sample(logits)
         emitted = 0
-        for slot, req in list(self._slot_req.items()):
+        for slot, req in running:
             token = int(nxt[slot])
             req.output_tokens.append(token)
             self._current[slot] = token
             emitted += 1
             self._maybe_retire(req, token, finished)
-        self.metrics.record_decode_step(emitted, live,
+        self.metrics.record_decode_step(emitted, len(running),
                                         step_s=self._now() - t0)
 
     def _spec_decode_step(self, finished: List[Request], t0: float) -> None:
@@ -259,9 +496,14 @@ class ServingEngine:
         K = self._spec.k
         B = self.pool.num_slots
 
+        # PREFILLING slots keep histories[slot] = None: the drafter
+        # proposes nothing for them (draft_len 0) and their deltas stay
+        # 0 below, so verify's masked garbage writes are rolled back by
+        # the index overwrite and later overwritten by their next chunk
         histories: List[Optional[np.ndarray]] = [None] * B
         for slot, req in self._slot_req.items():
-            histories[slot] = req.tokens()
+            if req.state is RequestState.RUNNING:
+                histories[slot] = req.tokens()
         draft, draft_len = self._drafter.propose(histories, K)
         draft = np.asarray(draft, np.int32)
         draft_len = np.clip(np.asarray(draft_len, np.int32), 0, K)
@@ -281,7 +523,8 @@ class ServingEngine:
 
         deltas = np.zeros((B,), np.int32)
         emitted = drafted = accepted = 0
-        live = list(self._slot_req.items())
+        live = [(slot, req) for slot, req in self._slot_req.items()
+                if req.state is RequestState.RUNNING]
         for slot, req in live:
             e = int(n_emit[slot])
             # the cache row holds e new positions regardless of how many
@@ -305,11 +548,26 @@ class ServingEngine:
 
     def _abort_step(self, granted: List[Request]) -> None:
         """Mid-step exception recovery: never leak a slot. Requests the
-        failed _admit already rolled back to QUEUED re-join the queue
-        head; running requests lose their (possibly donated-away) KV
-        state and are FAILED; the pool restarts from a fresh cache."""
+        failed admission already rolled back to QUEUED re-join the queue
+        head; PREFILLING requests lose only cache state that can be
+        rebuilt from the prompt, so they are scrubbed and re-queued too
+        (ahead of the granted ones — they are older); running requests
+        lose their (possibly donated-away) KV state and are FAILED; the
+        pool restarts from a fresh cache."""
         self.scheduler.requeue_front(
             [r for r in granted if r.state is RequestState.QUEUED])
+        prefilling = sorted(
+            (r for r in self._slot_req.values()
+             if r.state is RequestState.PREFILLING),
+            key=lambda r: r.request_id)
+        for req in prefilling:
+            del self._slot_req[req.slot]
+            req.slot = None
+            req.admit_time = None
+            req.prefill_pos = 0
+            del req.output_tokens[:]
+        self.scheduler.requeue_front(prefilling)
+        self._prefill_queue[:] = []
         for req in self._slot_req.values():
             req.state = RequestState.FAILED
             req.finish_reason = "error"
@@ -322,8 +580,9 @@ class ServingEngine:
     def run_until_drained(self, max_steps: Optional[int] = None
                           ) -> List[Request]:
         """Step until the queue and every slot are empty (or ``max_steps``).
-        Every step with live work produces at least one token and every
-        request's budget is finite, so this terminates."""
+        Every step with live work either emits a token or advances a
+        prefill by a full chunk, and every prompt and budget is finite,
+        so this terminates."""
         out: List[Request] = []
         steps = 0
         while self.scheduler.pending or self._slot_req:

@@ -43,6 +43,20 @@ class ServingMetrics:
         self.accepted_drafts: int = 0
         self.draft_time: float = 0.0
         self.step_time: float = 0.0
+        # prefill/decode split (stall-free admission): stall_time is the
+        # subset of prefill wall-time that ran while decode slots were
+        # live — the head-of-line blocking the chunked/budgeted admission
+        # policy exists to bound
+        self.prefill_tokens: int = 0
+        self.prefill_dispatches: int = 0
+        self.prefill_time: float = 0.0
+        self.stall_time: float = 0.0
+        # whole-step wall times for steps where a RUNNING request was
+        # waiting at step start: each is one user-visible inter-token
+        # gap, admissions included. The per-request mean (per_token_*)
+        # amortizes a monolithic prefill stall away; the p99 of THESE is
+        # the jitter/SLO tail stall-free admission exists to bound
+        self.step_gaps: List[float] = []
 
     # ------------------------------------------------------------------
     def record_rejection(self, req: Request) -> None:
@@ -81,9 +95,30 @@ class ServingMetrics:
                  emitted / max(live_slots, 1), self.decode_steps),
             ])
 
+    def record_step_gap(self, seconds: float) -> None:
+        """One full scheduler step during which at least one RUNNING
+        request was waiting on its next token (see ``step_gaps``)."""
+        self.step_gaps.append(seconds)
+
+    def record_prefill(self, tokens: int, seconds: float,
+                       blocking: bool) -> None:
+        """One prefill dispatch (bucketed admission batch or one chunk):
+        ``tokens`` of prompt processed in ``seconds``; ``blocking`` means
+        live decode slots were waiting on it (stall time)."""
+        self.prefill_tokens += tokens
+        self.prefill_dispatches += 1
+        self.prefill_time += seconds
+        if blocking:
+            self.stall_time += seconds
+
     def record_finish(self, req: Request) -> None:
         self.finished.append(req)
         if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            if req.finish_reason == "length_cap":
+                # a slot hit the allocated max_seq_len mid-generation —
+                # ops-worthy (capacity sizing), so it gets its own event
+                self.monitor.write_events([
+                    ("serving/finished/length_cap", 1.0, req.request_id)])
             self.monitor.write_events([
                 ("serving/ttft_ms", (req.ttft or 0.0) * 1e3, req.request_id),
                 ("serving/queue_wait_ms", (req.queue_wait or 0.0) * 1e3,
@@ -131,6 +166,11 @@ class ServingMetrics:
             "draft_overhead_pct": (
                 100.0 * self.draft_time / self.step_time
                 if self.step_time > 0 else None),
+            "prefill_tokens": self.prefill_tokens,
+            "prefill_dispatches": self.prefill_dispatches,
+            "prefill_time_s": self.prefill_time,
+            "stall_time_s": self.stall_time,
+            "decode_time_s": self.step_time,
             "requests_per_s": (len(done) / span) if span else None,
             "tokens_per_s": (new_tokens / span) if span else None,
             "ttft_p50_ms": _pct([t * 1e3 for t in ttfts], 50),
@@ -138,4 +178,6 @@ class ServingMetrics:
             "queue_wait_p50_ms": _pct([w * 1e3 for w in waits], 50),
             "per_token_p50_ms": _pct([g * 1e3 for g in gaps], 50),
             "per_token_p99_ms": _pct([g * 1e3 for g in gaps], 99),
+            "step_gap_p50_ms": _pct([g * 1e3 for g in self.step_gaps], 50),
+            "step_gap_p99_ms": _pct([g * 1e3 for g in self.step_gaps], 99),
         }

@@ -1,8 +1,10 @@
 """Request model for the serving subsystem.
 
 A :class:`Request` is one user generation job moving through the
-lifecycle ``QUEUED -> RUNNING -> FINISHED`` (or ``REJECTED`` straight
-out of admission control). The object doubles as the per-request SLO
+lifecycle ``QUEUED -> [PREFILLING ->] RUNNING -> FINISHED`` (or
+``REJECTED`` straight out of admission control; ``PREFILLING`` is the
+stall-free chunked-admission stage for prompts longer than the serving
+engine's chunk width). The object doubles as the per-request SLO
 record: the scheduler stamps wall-clock times at each transition and the
 latency metrics (TTFT, queue wait, per-token latency) are derived
 properties, so there is exactly one place timing truth lives.
@@ -19,6 +21,8 @@ import numpy as np
 
 class RequestState(enum.Enum):
     QUEUED = "queued"
+    PREFILLING = "prefilling"  # seated in a slot, prompt streaming in by
+    #                            bounded chunks (stall-free admission)
     RUNNING = "running"
     FINISHED = "finished"
     REJECTED = "rejected"
@@ -43,8 +47,11 @@ class Request:
 
     state: RequestState = RequestState.QUEUED
     reject_reason: Optional[str] = None     # "queue_full" | "prompt_too_long"
-    finish_reason: Optional[str] = None     # "eos" | "length" | "error"
+    finish_reason: Optional[str] = None     # "eos" | "length" | "length_cap"
+    #                                         | "error"
     slot: Optional[int] = None
+    prefill_pos: int = 0                    # prompt tokens already written
+    #                                         into the slot (chunked prefill)
     output_tokens: List[int] = dataclasses.field(default_factory=list)
 
     submit_time: Optional[float] = None

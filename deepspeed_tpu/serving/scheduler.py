@@ -14,6 +14,13 @@ this step. Two policies:
   drained, then seat a whole batch at once. This is the baseline arm of
   the serving benchmark — same engine, same kernels, only the admission
   policy differs — so the bench row isolates the scheduling win.
+
+Under the serving engine's stall-free mode, ``grant`` additionally
+enforces a per-step prefill TOKEN BUDGET (Sarathi-style): admission
+stops charging new prompts once the step's prefill work — bucketed
+whole-prompt admissions plus at most one in-flight chunk — would exceed
+the budget, so a burst of arrivals can no longer stall live decode
+slots behind an unbounded prefill wave.
 """
 
 from __future__ import annotations
@@ -66,11 +73,29 @@ class FIFOScheduler:
             r.state = RequestState.QUEUED
             self.queue.appendleft(r)
 
-    def grant(self, free_slots: int, live_slots: int) -> List[Request]:
-        """Pop the requests that may take a slot this step."""
+    def grant(self, free_slots: int, live_slots: int,
+              token_budget: Optional[int] = None,
+              cost=None, spent: int = 0) -> List[Request]:
+        """Pop the requests that may take a slot this step.
+
+        With ``token_budget``/``cost`` (the stall-free admission policy),
+        each pop is charged ``cost(req)`` prefill tokens against the
+        budget and the FIFO head blocks further grants when it no longer
+        fits — per-step prefill work is bounded by tokens, not by how
+        many slots happen to be free. ``spent`` is prefill work the
+        caller already committed this step (an in-flight chunk);
+        liveness guard: when NOTHING has been spent or granted yet, the
+        head is granted even if its cost alone exceeds the budget
+        (bounded overshoot beats a permanently stuck queue)."""
         if self.policy == "gang" and live_slots > 0:
             return []  # batch-synchronous: wait for the whole gang to drain
         granted: List[Request] = []
+        remaining = None if token_budget is None else token_budget - spent
         while self.queue and len(granted) < free_slots:
+            if remaining is not None:
+                c = cost(self.queue[0]) if cost is not None else 0
+                if c > remaining and (granted or spent > 0):
+                    break
+                remaining -= c
             granted.append(self.queue.popleft())
         return granted

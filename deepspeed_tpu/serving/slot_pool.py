@@ -45,10 +45,14 @@ class SlotPool:
         self.starts = np.zeros((num_slots,), np.int32)
         self._free = list(range(num_slots))
         heapq.heapify(self._free)  # smallest slot first: deterministic layout
+        # free-SET mirror of the heap: membership checks (the double-free
+        # guard) are O(1) instead of an O(n) heap scan on every release
+        self._free_set = set(self._free)
         # donate the pool (updated in place in HBM); the (L, 1, ...)
         # prefill cache is NOT donated — its shapes can never alias the
         # (L, num_slots, ...) outputs, so donating it only warns
         self._admit_jit = jax.jit(self._admit_row, donate_argnums=(0,))
+        self._admit_rows_jit = jax.jit(self._admit_rows, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     @property
@@ -63,20 +67,24 @@ class SlotPool:
         if not self._free:
             raise RuntimeError("slot pool exhausted (scheduler bug: admit "
                                "called without a free slot)")
-        return heapq.heappop(self._free)
+        slot = heapq.heappop(self._free)
+        self._free_set.discard(slot)
+        return slot
 
     def release(self, slot: int) -> None:
         """Return a slot to the free pool. Double-releasing corrupts the
         free heap (the slot would be granted to TWO requests whose cache
         rows then clobber each other), so it raises instead of silently
-        corrupting ``free_count``."""
+        corrupting ``free_count`` — the guard is an O(1) set-membership
+        check against the heap's set mirror."""
         if not 0 <= slot < self.num_slots:
             raise ValueError(f"slot {slot} out of range "
                              f"[0, {self.num_slots})")
-        if slot in self._free:
+        if slot in self._free_set:
             raise RuntimeError(f"double release of slot {slot} (already "
                                f"free; scheduler/engine bug)")
         heapq.heappush(self._free, slot)
+        self._free_set.add(slot)
 
     def reset(self) -> None:
         """Recovery path: free every slot and reallocate a zeroed device
@@ -87,6 +95,18 @@ class SlotPool:
         self.starts[:] = 0
         self._free = list(range(self.num_slots))
         heapq.heapify(self._free)
+        self._free_set = set(self._free)
+
+    def reset_row(self, slot: int) -> None:
+        """Zero a freshly-alloc'd slot's index (host mirror AND device)
+        before an incremental (chunked) prefill starts writing it: the
+        retired occupant's index would otherwise offset the first chunk's
+        write. Pure index movement — the stale K/V itself is dead by
+        masking and gets overwritten chunk by chunk."""
+        self.starts[slot] = 0
+        cs = dict(self.cache["cache_store"])
+        cs["index"] = jnp.asarray(self.starts)
+        self.cache = {"cache_store": cs}
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -105,6 +125,40 @@ class SlotPool:
         out["index"] = pool["index"].at[jnp.asarray(slot, jnp.int32)].set(
             jnp.asarray(length, jnp.int32))
         return out
+
+    @staticmethod
+    def _admit_rows(pool: dict, pre: dict, slots, lengths):
+        """Scatter a BATCHED (L, nB, ...) prefill cache into ``nB`` slot
+        rows in one program. ``slots``/``lengths`` are (nB,) int32 and
+        traced, so one compile covers every slot combination at a given
+        batch bucket; padding rows carry slot == num_slots, which JAX's
+        scatter drop-mode discards instead of writing anywhere."""
+        out = {k: pool[k].at[:, slots].set(pre[k].astype(pool[k].dtype),
+                                           mode="drop")
+               for k in pool if k != "index"}
+        out["index"] = pool["index"].at[slots].set(
+            jnp.asarray(lengths, jnp.int32), mode="drop")
+        return out
+
+    def admit_rows(self, prefill_cache: dict, slots, lengths) -> None:
+        """Install ``nB`` prefilled sequences into ``nB`` slots (alloc'd
+        by the caller) in ONE jitted multi-row scatter — the batched
+        admission path. ``slots`` may contain the sentinel ``num_slots``
+        for batch-bucket padding rows (dropped, never written); real
+        entries must be alloc'd and in range."""
+        slots = np.asarray(slots, np.int32)
+        lengths = np.asarray(lengths, np.int32)
+        if slots.shape != lengths.shape or slots.ndim != 1:
+            raise ValueError(f"admit_rows needs matching 1-D slots/lengths; "
+                             f"got {slots.shape} vs {lengths.shape}")
+        real = slots < self.num_slots
+        if np.any(lengths[real] > self.capacity):
+            raise ValueError(f"sequence length {int(lengths[real].max())} "
+                             f"exceeds slot capacity {self.capacity}")
+        self.cache = {"cache_store": self._admit_rows_jit(
+            self.cache["cache_store"], prefill_cache["cache_store"],
+            jnp.asarray(slots), jnp.asarray(lengths))}
+        self.starts[slots[real]] = lengths[real]
 
     def admit(self, prefill_cache: dict, slot: int, length: int) -> None:
         """Install a prefilled sequence into ``slot`` (alloc'd by caller)."""

@@ -1,12 +1,15 @@
 """Drafters: propose K tokens per live slot for one verify forward.
 
 A drafter is HOST-side policy with a fixed-shape contract: given one
-history per slot (``None`` for dead slots), return ``(tokens, counts)``
-where ``tokens`` is ``(num_slots, K)`` int32 and ``counts`` is
-``(num_slots,)`` int32 with ``counts[i]`` real proposals in row ``i``
-(the rest is padding the verifier masks). A slot with ``counts == 0``
-degrades to a plain decode step inside the same verify program — no
-shape change, no recompile, just zero accepted drafts.
+history per slot (``None`` for dead slots AND for slots still
+``PREFILLING`` under stall-free chunked admission — the serving engine
+withholds their histories, so no draft is ever proposed against a
+half-written cache row), return ``(tokens, counts)`` where ``tokens``
+is ``(num_slots, K)`` int32 and ``counts`` is ``(num_slots,)`` int32
+with ``counts[i]`` real proposals in row ``i`` (the rest is padding the
+verifier masks). A slot with ``counts == 0`` degrades to a plain decode
+step inside the same verify program — no shape change, no recompile,
+just zero accepted drafts.
 
 Correctness never depends on the drafter: verification accepts exactly
 the prefix the target model reproduces (greedy) or rejection-samples

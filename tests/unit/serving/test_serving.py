@@ -89,7 +89,9 @@ def test_slot_reuse_does_not_recompile(stack):
     _, _, engine = stack
     rng = np.random.default_rng(5)
     srv = ServingEngine(engine, num_slots=2, max_queue_depth=16)
-    for _ in range(2):  # wave A: compile everything once
+    # wave A: compile everything once — 3 requests over 2 slots so both
+    # admission batch buckets (nB=2 full step, nB=1 single refill) warm up
+    for _ in range(3):
         srv.submit(rng.integers(0, 64, size=6).astype(np.int32),
                    max_new_tokens=3)
     srv.run_until_drained(max_steps=50)
