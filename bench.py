@@ -36,7 +36,16 @@ inter-token-gap p99 (>1 means the streaming tail shrank).
 ``--json <path>`` additionally writes the full result object to
 ``<path>`` (e.g. ``BENCH_serving.json``) for dashboards/drivers.
 ``check_regression.py`` diffs two such files and gates on named
-metrics.
+metrics (and on ``detail.recompiles_after_warmup`` via
+``--max-recompiles`` — every serving row reports it from the runtime
+recompile watchdog after a post-run warm replay).
+
+``--trace <path>`` additionally writes a Chrome trace-event / Perfetto
+JSON timeline (open at ui.perfetto.dev) for the row: serving rows run
+one extra traced replay on the warmed server (step-phase spans +
+per-request lifecycle lanes + flow events) and report the tracer's
+throughput overhead vs an untraced replay; the training row traces one
+extra ``train_batch`` step.
 
 ``vs_baseline`` compares achieved model TFLOPS against the reference's
 headline single-device number: 64 TFLOPS/GPU for BERT-Large pretraining
@@ -57,7 +66,8 @@ import numpy as np
 
 V5E_PEAK_TFLOPS = 197.0
 
-_JSON_PATH = None  # set by __main__ from --json <path>
+_JSON_PATH = None   # set by __main__ from --json <path>
+_TRACE_PATH = None  # set by __main__ from --trace <path>
 
 
 def _emit(result: dict) -> None:
@@ -142,6 +152,15 @@ def main():
     tokens_per_step = engine.train_batch_size() * SEQ
     tok_s_chip = tokens_per_step * steps / dt / n_chips
 
+    trace_events = None
+    if _TRACE_PATH:
+        # one extra traced step AFTER timing (train_batch phase spans)
+        from deepspeed_tpu.telemetry import Tracer
+
+        engine.tracer = Tracer()
+        jax.block_until_ready(engine.train_batch(batch=make_batch()))
+        trace_events = engine.tracer.export(_TRACE_PATH)
+
     n_params = engine.num_parameters
     # three accountings, strictest to reference-convention (see module doc)
     attn_full = 12 * N_LAYER * SEQ * N_EMBD       # QK^T + AV, fwd+bwd
@@ -172,6 +191,8 @@ def main():
             "mfu_pct_full_attn": round(
                 100 * tf["full_attn"] / V5E_PEAK_TFLOPS, 1),
             "loss": float(loss),
+            "tracer": ({"path": _TRACE_PATH, "events": trace_events}
+                       if _TRACE_PATH else None),
         },
     })
 
@@ -219,9 +240,9 @@ def serving_main():
                             ).astype(np.int32) for _ in range(n_req)]
     budgets = gen.integers(gen_lo, gen_hi + 1, size=n_req)
 
-    def run_arm(policy: str) -> dict:
+    def run_arm(policy: str, tracer=None):
         srv = ServingEngine(engine, num_slots=slots, max_queue_depth=n_req,
-                            policy=policy)
+                            policy=policy, tracer=tracer)
         t0 = time.perf_counter()
         i = 0
         while i < n_req or srv.pending or srv.live_count:
@@ -233,7 +254,7 @@ def serving_main():
                 time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
                 continue
             srv.step()
-        return srv.stats()
+        return srv.stats(), srv
 
     # warmup: compile every prefill bucket + admit + decode + sample once;
     # must include len_hi so the TOP bucket is compiled before timing starts
@@ -245,9 +266,62 @@ def serving_main():
             break
         w = min(w * 2, len_hi)
     warm.run_until_drained()
+    # ...and every BATCHED admission combo: stall-free admission compiles
+    # one program per (rows, bucket) pair, so same-bucket pairs and
+    # slot-full groups must run here or the timed Poisson run (and the
+    # post-run recompile probe) pays first-touch compiles mid-flight
+    w = len_lo
+    while True:
+        for group in (2, slots):
+            for _ in range(group):
+                warm.submit(np.zeros((w,), np.int32), max_new_tokens=2)
+            warm.run_until_drained()
+        if w >= len_hi:
+            break
+        w = min(w * 2, len_hi)
+    # ...and every BATCHED admission combo: stall-free admission compiles
+    # one program per (rows, bucket) pair, so same-bucket pairs and
+    # slot-full groups must run here or the timed Poisson run (and the
+    # post-run recompile probe) pays first-touch compiles mid-flight
+    w = len_lo
+    while True:
+        for group in (2, slots):
+            for _ in range(group):
+                warm.submit(np.zeros((w,), np.int32), max_new_tokens=2)
+            warm.run_until_drained()
+        if w >= len_hi:
+            break
+        w = min(w * 2, len_hi)
 
-    cont = run_arm("continuous")
-    gang = run_arm("gang")
+    cont, srv_cont = run_arm("continuous")
+    gang, _ = run_arm("gang")
+
+    # recompile probe — AFTER timing: declare warmup over on the fully
+    # exercised server and replay a slice of the workload; any cache
+    # growth now is real compilation churn (the gate --max-recompiles
+    # reads this as detail.recompiles_after_warmup)
+    srv_cont.end_warmup()
+    for p, b in zip(prompts[:8], budgets[:8]):
+        srv_cont.submit(p, max_new_tokens=int(b))
+    srv_cont.run_until_drained()
+    recompiles = srv_cont.watchdog.recompiles
+
+    tracer_detail = None
+    if _TRACE_PATH:
+        from deepspeed_tpu.telemetry import Tracer
+
+        # overhead = traced vs untraced replay of the SAME warmed arm
+        base, _ = run_arm("continuous")
+        traced, srv_tr = run_arm("continuous", tracer=Tracer())
+        n_events = srv_tr.tracer.export(_TRACE_PATH)
+        overhead = 100.0 * (base["requests_per_s"] -
+                            traced["requests_per_s"]) / base["requests_per_s"]
+        tracer_detail = {
+            "path": _TRACE_PATH, "events": n_events,
+            "traced_requests_per_s": round(traced["requests_per_s"], 3),
+            "untraced_requests_per_s": round(base["requests_per_s"], 3),
+            "overhead_pct": round(overhead, 2),
+        }
 
     def arm_detail(s):
         return {"requests_per_s": round(s["requests_per_s"], 3),
@@ -271,6 +345,8 @@ def serving_main():
             "baseline": "gang (batch-synchronous) admission at equal slot "
                         "count — the generate() discipline on the same "
                         "engine and kernels",
+            "recompiles_after_warmup": int(recompiles),
+            "tracer": tracer_detail,
             "continuous": arm_detail(cont),
             "gang": arm_detail(gang),
         },
@@ -358,7 +434,8 @@ def serving_stall_main():
 
     def run_arm(srv: ServingEngine, timed: bool) -> dict:
         if timed:  # fresh aggregates; warmup polluted them
-            srv.metrics = ServingMetrics(None)
+            srv.metrics = ServingMetrics(None, registry=srv.registry,
+                                         step_fn=lambda s=srv: s.step_id)
         reqs = []
         t0 = time.perf_counter()
         i = 0
@@ -388,6 +465,11 @@ def serving_stall_main():
     assert arm_sf._stall_free and not arm_serial._stall_free
     warm_arm(arm_sf)
     warm_arm(arm_serial)
+    # both arms fully warmed: the runtime watchdogs now count any cache
+    # growth as a real recompile (both watch the SHARED engine jits, so
+    # max() rather than sum() avoids double-counting those)
+    arm_sf.end_warmup()
+    arm_serial.end_warmup()
     n_decode_programs = engine._jit_decode._cache_size()
 
     # interleaved replications with per-metric medians: single CPU
@@ -399,10 +481,21 @@ def serving_stall_main():
         serial_runs.append(run_arm(arm_serial, timed=True))
 
     decode_recompiles = engine._jit_decode._cache_size() - n_decode_programs
+    recompiles = max(arm_sf.watchdog.recompiles,
+                     arm_serial.watchdog.recompiles)
     # greedy: outputs must be bitwise identical across arms AND reps
     # (admission grouping varies with timing; results must not)
     parity = all(r["outputs"] == serial_runs[0]["outputs"]
                  for r in sf_runs + serial_runs)
+
+    tracer_detail = None
+    if _TRACE_PATH:
+        from deepspeed_tpu.telemetry import Tracer
+
+        arm_sf.set_tracer(Tracer())
+        run_arm(arm_sf, timed=True)     # traced replay on the warmed arm
+        n_events = arm_sf.tracer.export(_TRACE_PATH)
+        tracer_detail = {"path": _TRACE_PATH, "events": n_events}
 
     _MED_KEYS = ("requests_per_s", "tokens_per_s", "ttft_p50_ms",
                  "ttft_p99_ms", "per_token_p50_ms", "per_token_p99_ms",
@@ -446,6 +539,8 @@ def serving_stall_main():
                         "stall-free arm's (>1: the tail shrank)",
             "greedy_parity": bool(parity),
             "decode_recompiles_after_warmup": int(decode_recompiles),
+            "recompiles_after_warmup": int(recompiles),
+            "tracer": tracer_detail,
             "replications": reps,
             "ttft_p99_ratio": round(serial["ttft_p99_ms"] /
                                     max(sf["ttft_p99_ms"], 1e-9), 3),
@@ -516,11 +611,28 @@ def spec_main():
         s["wall_s"] = wall
         s["outputs"] = {r.request_id % n_req: list(r.output_tokens)
                         for r in done}
-        return s
+        return s, srv
 
     run_arm(None), run_arm(spec_cfg)       # warmup: compile both arms
-    plain = run_arm(None)
-    spec = run_arm(spec_cfg)
+    plain, _ = run_arm(None)
+    spec, srv_spec = run_arm(spec_cfg)
+
+    # post-run recompile probe (+ traced replay when --trace is given):
+    # the spec arm's server is fully exercised, so a warm replay of the
+    # workload must not grow any executable cache
+    srv_spec.end_warmup()
+    if _TRACE_PATH:
+        from deepspeed_tpu.telemetry import Tracer
+
+        srv_spec.set_tracer(Tracer())
+    for p, b in zip(prompts, budgets):
+        srv_spec.submit(p, max_new_tokens=b)
+    srv_spec.run_until_drained()
+    tracer_detail = None
+    if _TRACE_PATH:
+        tracer_detail = {"path": _TRACE_PATH,
+                         "events": srv_spec.tracer.export(_TRACE_PATH)}
+    recompiles = srv_spec.watchdog.recompiles
 
     parity = plain["outputs"] == spec["outputs"]  # greedy: must be bitwise
     tps_plain = plain["new_tokens"] / plain["wall_s"]
@@ -538,6 +650,8 @@ def spec_main():
                         "workload (tokens_per_decode_step == 1.0 by "
                         "construction)",
             "greedy_parity": bool(parity),
+            "recompiles_after_warmup": int(recompiles),
+            "tracer": tracer_detail,
             "acceptance_rate": round(spec["spec_acceptance_rate"], 3)
             if spec["spec_acceptance_rate"] is not None else None,
             "draft_overhead_pct": round(spec["draft_overhead_pct"], 2)
@@ -570,6 +684,8 @@ if __name__ == "__main__":
     argv = sys.argv[1:]
     if "--json" in argv:
         _JSON_PATH = argv[argv.index("--json") + 1]
+    if "--trace" in argv:
+        _TRACE_PATH = argv[argv.index("--trace") + 1]
     if "serving-stall" in argv:
         entry = serving_stall_main
     elif "spec" in argv:

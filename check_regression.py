@@ -3,7 +3,8 @@
 
 Usage:
     python check_regression.py BASELINE.json CANDIDATE.json \
-        [--metric PATH[:higher|lower]] ... [--threshold 0.10]
+        [--metric PATH[:higher|lower]] ... [--threshold 0.10] \
+        [--max-recompiles N]
 
 Each ``--metric`` names a dotted path into the result object (e.g.
 ``value``, ``detail.stall_free.requests_per_s``) with an optional
@@ -14,6 +15,13 @@ direction suffix: ``higher`` (default) means larger is better,
 A metric regresses when the candidate is worse than the baseline by
 more than ``--threshold`` (default 0.10 = 10%), measured relative to
 the baseline. Improvements and within-threshold noise pass.
+
+``--max-recompiles N`` additionally gates on compilation churn: the
+candidate's ``detail.recompiles_after_warmup`` (every serving
+``bench.py`` row reports it from the runtime recompile watchdog) must
+not exceed N. This is an absolute cap on the candidate alone — no
+baseline comparison and no threshold slack, because post-warmup
+recompiles are a zero-tolerance invariant, not a noisy measurement.
 
 Exit codes: 0 = all metrics within threshold, 1 = at least one
 regression, 2 = unusable input (missing file, bad JSON, missing metric,
@@ -80,6 +88,11 @@ def main(argv=None) -> int:
                          "default: value:higher")
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="max allowed relative regression (default 0.10)")
+    ap.add_argument("--max-recompiles", type=int, default=None,
+                    metavar="N",
+                    help="absolute cap on the candidate's "
+                         "detail.recompiles_after_warmup (no baseline, "
+                         "no threshold slack)")
     args = ap.parse_args(argv)
 
     base = _load(args.baseline)
@@ -87,6 +100,14 @@ def main(argv=None) -> int:
     specs = args.metric or ["value:higher"]
 
     failed = False
+    if args.max_recompiles is not None:
+        dotted = "detail.recompiles_after_warmup"
+        r = _resolve(cand, dotted, args.candidate)
+        worse = r > args.max_recompiles
+        tag = "REGRESSION" if worse else "ok"
+        print(f"{tag:>10}  {dotted} (absolute): candidate={r:g} "
+              f"max={args.max_recompiles}")
+        failed |= worse
     for spec in specs:
         dotted, direction = _parse_metric(spec)
         b = _resolve(base, dotted, args.baseline)

@@ -123,7 +123,11 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
     serving-only keys ``policy``, ``do_sample``, ``temperature``,
     ``top_k``, ``top_p``, ``seed``, ``monitor``, ``spec_decode``,
     ``prefill_chunk`` and ``prefill_token_budget`` (stall-free chunked
-    admission; 0 disables), which pass through to ServingEngine, plus
+    admission; 0 disables), the telemetry keys ``tracer`` (a
+    :class:`telemetry.Tracer`, or ``True`` for a default-capacity one),
+    ``registry``, ``strict_recompile`` (raise at the step boundary on
+    any post-warmup recompile) and ``timeline_capacity``, which pass
+    through to ServingEngine, plus
     ``num_slots`` / ``max_queue_depth``. **Per-request** (ride on each ``submit()``):
     ``max_new_tokens`` and ``eos_token_id`` — nothing else varies per
     request, so slot churn never changes a compiled shape. Everything
@@ -140,7 +144,8 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
 
     serve_keys = ("policy", "do_sample", "temperature", "top_k", "top_p",
                   "seed", "monitor", "spec_decode", "prefill_chunk",
-                  "prefill_token_budget")
+                  "prefill_token_budget", "tracer", "registry",
+                  "strict_recompile", "timeline_capacity")
     serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
     engine = init_inference(model=model, config=config, **kwargs)
     return ServingEngine(engine, num_slots=num_slots,

@@ -2,8 +2,9 @@
 ``(tag, value, step)`` event sinks. Both training and the serving
 subsystem emit through :class:`MonitorMaster`."""
 
-from .monitor import (Event, Monitor, MonitorMaster,  # noqa: F401
-                      TensorBoardMonitor, WandbMonitor, csvMonitor)
+from .monitor import (Event, JSONLMonitor, Monitor,  # noqa: F401
+                      MonitorMaster, TensorBoardMonitor, WandbMonitor,
+                      csvMonitor)
 
 __all__ = ["Event", "Monitor", "MonitorMaster", "TensorBoardMonitor",
-           "WandbMonitor", "csvMonitor"]
+           "WandbMonitor", "csvMonitor", "JSONLMonitor"]

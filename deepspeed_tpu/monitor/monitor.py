@@ -3,15 +3,20 @@
 Capability parity with reference ``deepspeed/monitor/monitor.py`` — ``Monitor``
 ABC (:13) + ``MonitorMaster`` fan-out (:29) to TensorBoard
 (monitor/tensorboard.py:13), W&B (monitor/wandb.py:12) and CSV
-(monitor/csv_monitor.py:12). Events are ``(tag, value, step)`` tuples, written
-only from process 0 (rank gating as in the reference).
+(monitor/csv_monitor.py:12), plus a dependency-free ``JSONLMonitor``
+(one JSON object per event with a wall-clock timestamp — the machine-
+readable sink telemetry flushes route through). Events are ``(tag,
+value, step)`` tuples, written only from process 0 (rank gating as in
+the reference).
 """
 
 from __future__ import annotations
 
 import abc
 import csv
+import json
 import os
+import time
 from typing import List, Optional, Tuple
 
 from ..utils.logging import logger
@@ -105,6 +110,34 @@ class csvMonitor(Monitor):
                 w.writerow([step, float(value)])
 
 
+class JSONLMonitor(Monitor):
+    """Append-only JSON-lines sink: one object per event, stamped with
+    wall-clock time. No torch/wandb dependency — this is the sink
+    machine consumers (and the telemetry registry flush) read back, so
+    the format is one ``json.loads``-able line per event:
+
+    ``{"tag": "serving/ttft_ms", "value": 6.7, "step": 42, "time": ...}``
+    """
+
+    def __init__(self, jsonl_config):
+        super().__init__(jsonl_config)
+        self.path: Optional[str] = None
+        if self.enabled and _is_rank_zero():
+            log_dir = os.path.join(jsonl_config.output_path or "jsonl_monitor",
+                                   jsonl_config.job_name)
+            os.makedirs(log_dir, exist_ok=True)
+            self.path = os.path.join(log_dir, "events.jsonl")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.path is None or not (self.enabled and _is_rank_zero()):
+            return
+        now = time.time()
+        with open(self.path, "a") as fh:
+            for name, value, step in event_list:
+                fh.write(json.dumps({"tag": name, "value": float(value),
+                                     "step": int(step), "time": now}) + "\n")
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all enabled monitors (reference monitor/monitor.py:29)."""
 
@@ -113,6 +146,7 @@ class MonitorMaster(Monitor):
         self.tb_monitor: Optional[TensorBoardMonitor] = None
         self.wandb_monitor: Optional[WandbMonitor] = None
         self.csv_monitor: Optional[csvMonitor] = None
+        self.jsonl_monitor: Optional[JSONLMonitor] = None
         self.enabled = monitor_config.enabled
         if _is_rank_zero():
             if monitor_config.tensorboard.enabled:
@@ -121,10 +155,14 @@ class MonitorMaster(Monitor):
                 self.wandb_monitor = WandbMonitor(monitor_config.wandb)
             if monitor_config.csv_monitor.enabled:
                 self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+            if getattr(monitor_config, "jsonl", None) is not None and \
+                    monitor_config.jsonl.enabled:
+                self.jsonl_monitor = JSONLMonitor(monitor_config.jsonl)
 
     def write_events(self, event_list: List[Event]) -> None:
         if not _is_rank_zero():
             return
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor,
+                  self.jsonl_monitor):
             if m is not None and m.enabled:
                 m.write_events(event_list)

@@ -83,14 +83,22 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class JSONLConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
 class MonitorConfig(DeepSpeedConfigModel):
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    jsonl: JSONLConfig = Field(default_factory=JSONLConfig)
 
     @property
     def enabled(self) -> bool:
-        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+        return (self.tensorboard.enabled or self.wandb.enabled
+                or self.csv_monitor.enabled or self.jsonl.enabled)
 
 
 class CommsLoggerConfig(DeepSpeedConfigModel):
@@ -287,7 +295,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
 
         # legacy top-level monitor keys fold into monitor_config
         monitor = data.setdefault("monitor_config", {})
-        for legacy in ("tensorboard", "wandb", "csv_monitor"):
+        for legacy in ("tensorboard", "wandb", "csv_monitor", "jsonl"):
             if legacy in data and legacy not in monitor:
                 monitor[legacy] = data[legacy]
 

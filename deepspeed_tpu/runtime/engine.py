@@ -198,6 +198,10 @@ class DeepSpeedEngine:
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(), steps_per_output=self.steps_per_print())
         self.monitor = MonitorMaster(self._config.monitor_config)
+        # off by default; assign an enabled telemetry.Tracer to record
+        # train_batch phase spans (export via engine.tracer.export(path))
+        from ..telemetry import Tracer
+        self.tracer = Tracer(enabled=False)
         cl = self._config.comms_logger
         dist.configure(enabled=cl.enabled, prof_all=cl.prof_all, prof_ops=cl.prof_ops,
                        verbose=cl.verbose, debug=cl.debug)
@@ -770,26 +774,34 @@ class DeepSpeedEngine:
         self._maybe_profile_flops(stacked)
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
-        if self._param_offload is not None:
-            # streamed path: feed host micro batches (gas-major)
-            micros = [jax.tree_util.tree_map(lambda x, i=i: np.asarray(x[i]),
-                                             stacked)
-                      for i in range(self.gradient_accumulation_steps())]
-            metrics = self._param_offload.train_batch(micros)
-            self.state["step"] = self.state["step"] + 1
-            self.state["opt_step"] = self.state["opt_step"] + 1
-        elif self._offload_enabled:
-            self.state, grads_dev, metrics = self._jit_offload_grads(
-                self.state, stacked)
-            self._host_optimizer_step(grads_dev, metrics)
-        else:
-            self.state, metrics = self._jit_train_batch(self.state, stacked)
-        loss = metrics["loss"]
-        self.global_steps += 1
-        self.global_samples += self.train_batch_size()
-        self.micro_steps += self.gradient_accumulation_steps()
-        self.tput_timer.stop(global_step=True)
-        self.timers(TRAIN_BATCH_TIMER).stop()
+        with self.tracer.span("train/step", step=self.global_steps):
+            if self._param_offload is not None:
+                # streamed path: feed host micro batches (gas-major)
+                with self.tracer.span("train/offload_stream"):
+                    micros = [jax.tree_util.tree_map(
+                        lambda x, i=i: np.asarray(x[i]), stacked)
+                        for i in range(self.gradient_accumulation_steps())]
+                    metrics = self._param_offload.train_batch(micros)
+                self.state["step"] = self.state["step"] + 1
+                self.state["opt_step"] = self.state["opt_step"] + 1
+            elif self._offload_enabled:
+                with self.tracer.span("train/fwd_bwd"):
+                    self.state, grads_dev, metrics = self._jit_offload_grads(
+                        self.state, stacked)
+                with self.tracer.span("train/host_opt_step"):
+                    self._host_optimizer_step(grads_dev, metrics)
+            else:
+                with self.tracer.span("train/fwd_bwd_opt"):
+                    self.state, metrics = self._jit_train_batch(
+                        self.state, stacked)
+            loss = metrics["loss"]
+            self.global_steps += 1
+            self.global_samples += self.train_batch_size()
+            self.micro_steps += self.gradient_accumulation_steps()
+            # block on the step's outputs so the recorded wall time is
+            # compute, not async dispatch (see utils/timer.py)
+            self.tput_timer.stop(global_step=True, block_on=loss)
+            self.timers(TRAIN_BATCH_TIMER).stop(block_on=loss)
         self._after_step(metrics)
         return loss
 

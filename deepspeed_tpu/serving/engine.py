@@ -58,11 +58,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import (MetricsRegistry, RecompileWatchdog, TimelineStore,
+                         Tracer)
 from ..utils.logging import log_dist
 from .metrics import ServingMetrics
 from .request import Request, RequestState
 from .scheduler import FIFOScheduler
 from .slot_pool import SlotPool
+
+# jitted entry points the recompile watchdog wraps; verify_k is created
+# lazily on first use, so _ensure_watch re-checks the list every step
+_WATCHED_ENGINE_JITS = ("_jit_prefill_at", "_jit_decode",
+                        "_jit_prefill_chunk", "_jit_sample",
+                        "_jit_verify_k", "_jit_decode_scan")
+_WATCHED_POOL_JITS = ("_admit_jit", "_admit_rows_jit")
 
 _MIN_PREFILL_BUCKET = 16
 
@@ -85,7 +94,11 @@ class ServingEngine:
                  seed: int = 0, monitor: Optional[Any] = None,
                  spec_decode: Optional[Any] = None,
                  prefill_chunk: int = 64,
-                 prefill_token_budget: Optional[int] = None):
+                 prefill_token_budget: Optional[int] = None,
+                 tracer: Optional[Any] = None,
+                 registry: Optional[Any] = None,
+                 strict_recompile: bool = False,
+                 timeline_capacity: int = 4096):
         self.engine = engine
         # materialize params + jits before sizing anything off the module
         engine._ensure_params(jnp.zeros((1, 2), jnp.int32))
@@ -100,7 +113,14 @@ class ServingEngine:
                 "serving requires the module to expose prefill_last("
                 "input_ids, last_pos) for bucketed slot prefill")
         cfg = engine._config
-        self.pool = SlotPool(spec, num_slots)
+        # pin the pool to the engine's replicated sharding so the cold
+        # cache matches the committed arrays its jitted steps hand back
+        # (otherwise the first admission compiles a second executable)
+        rep = None
+        if getattr(engine, "mesh", None) is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(engine.mesh, PartitionSpec())
+        self.pool = SlotPool(spec, num_slots, sharding=rep)
         self._spec = None
         self._drafter = None
         sched_capacity = self.pool.capacity
@@ -120,7 +140,22 @@ class ServingEngine:
         self.scheduler = FIFOScheduler(num_slots, max_queue_depth,
                                        policy=policy,
                                        capacity=sched_capacity)
-        self.metrics = ServingMetrics(monitor)
+        # -- telemetry -------------------------------------------------
+        # the tracer defaults to DISABLED: span() then costs one branch
+        # + a shared null span, keeping the instrumented hot path within
+        # the 2% overhead budget when nobody is tracing
+        if tracer is True:
+            tracer = Tracer()
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.step_id = 0                 # monotonic scheduler-step counter
+        self.timelines = TimelineStore(capacity=timeline_capacity,
+                                       tracer=self.tracer)
+        self.watchdog = RecompileWatchdog(
+            registry=self.registry, tracer=self.tracer, monitor=monitor,
+            strict=strict_recompile, step_fn=lambda: self.step_id)
+        self.metrics = ServingMetrics(monitor, registry=self.registry,
+                                      step_fn=lambda: self.step_id)
         # -- stall-free admission config -------------------------------
         if prefill_chunk < 0:
             raise ValueError(f"prefill_chunk must be >= 0, got "
@@ -160,6 +195,7 @@ class ServingEngine:
         self._current = np.zeros((num_slots,), np.int32)  # last token per slot
         self._next_id = 0
         self._now = time.perf_counter
+        self._ensure_watch()
         log_dist(f"ServingEngine: slots={num_slots} policy={policy} "
                  f"capacity={self.pool.capacity} "
                  f"max_queue_depth={max_queue_depth} "
@@ -167,6 +203,41 @@ class ServingEngine:
                  ranks=[0])
 
     # ------------------------------------------------------------------
+    def _ensure_watch(self) -> None:
+        """(Re-)attach the recompile watchdog to every jitted entry point.
+
+        Idempotent and cheap (a handful of getattr/isinstance checks);
+        called once per step because ``_jit_verify_k`` is created lazily
+        on the first speculative verify and tests swap jits in and out."""
+        wd = self.watchdog
+        for attr in _WATCHED_ENGINE_JITS:
+            wd.attach(self.engine, attr, name=f"InferenceEngine.{attr}")
+        for attr in _WATCHED_POOL_JITS:
+            wd.attach(self.pool, attr, name=f"SlotPool.{attr}")
+
+    def end_warmup(self) -> None:
+        """Declare warmup traffic over: from here on, any recompile counts
+        against :attr:`watchdog` ``.recompiles`` (and raises in strict
+        mode at the next step boundary)."""
+        self.watchdog.end_warmup()
+
+    def set_tracer(self, tracer) -> None:
+        """Swap the tracer in post-construction (e.g. a traced replay on
+        an already-warmed server in ``bench.py --trace``)."""
+        self.tracer = tracer
+        self.timelines.tracer = tracer
+        self.watchdog.tracer = tracer
+
+    def timeline(self, request_id: int):
+        """Lifecycle events recorded for one request id (oldest first),
+        or None if the id is unknown/evicted."""
+        return self.timelines.get(request_id)
+
+    def publish_telemetry(self) -> int:
+        """Flush the metrics registry as ``telemetry/*`` monitor events
+        on the current step axis; returns the number of events."""
+        return self.registry.publish(self.metrics.monitor, self.step_id)
+
     @property
     def live_count(self) -> int:
         return len(self._slot_req)
@@ -189,10 +260,15 @@ class ServingEngine:
         self._next_id += 1
         req.submit_time = self._now()
         accepted, reason = self.scheduler.submit(req)
+        self.timelines.record(req.request_id, "submitted",
+                              prompt_len=req.prompt_len,
+                              max_new_tokens=max_new_tokens)
         if not accepted:
             req.state = RequestState.REJECTED
             req.reject_reason = reason
             self.metrics.record_rejection(req)
+            self.timelines.record(req.request_id, "rejected", terminal=True,
+                                  reason=reason)
         return req
 
     # ------------------------------------------------------------------
@@ -219,10 +295,15 @@ class ServingEngine:
             ids[0, :T] = req.prompt
             running_before = self._running_count()
             req.admit_time = self._now()
-            logits, pre_cache = eng._jit_prefill_at(
-                eng.params, jnp.asarray(ids), jnp.asarray(T - 1, jnp.int32))
-            self.pool.admit(pre_cache, slot, T)
-            token = int(self._sample(logits)[0])  # device sync: token exists
+            with self.tracer.span("serving/admit", rid=req.request_id,
+                                  tokens=T, width=width):
+                logits, pre_cache = eng._jit_prefill_at(
+                    eng.params, jnp.asarray(ids),
+                    jnp.asarray(T - 1, jnp.int32))
+                self.pool.admit(pre_cache, slot, T)
+                with self.tracer.span("serving/sample"):
+                    # device sync: token exists
+                    token = int(self._sample(logits)[0])
             req.first_token_time = self._now()
             self.metrics.record_prefill(T, req.first_token_time -
                                         req.admit_time,
@@ -232,6 +313,10 @@ class ServingEngine:
             req.state = RequestState.RUNNING
             req.output_tokens.append(token)
             self._current[slot] = token
+            self.timelines.record(req.request_id, "admitted", slot=slot,
+                                  mode="bucketed")
+            self.timelines.record(req.request_id, "first_token")
+            self.tracer.flow("s", "req", req.request_id)
         except Exception:
             # undo the partial admission so the request can be re-queued
             # with no trace: the slot goes back, timing/output state is
@@ -277,6 +362,9 @@ class ServingEngine:
                 req.state = RequestState.PREFILLING
                 self._slot_req[slot] = req
                 self._prefill_queue.append(req)
+                self.timelines.record(req.request_id, "admitted", slot=slot,
+                                      mode="chunked")
+                self.tracer.flow("s", "req", req.request_id)
             else:
                 groups.setdefault(self._bucket(T, self.pool.capacity),
                                   []).append(req)
@@ -317,10 +405,13 @@ class ServingEngine:
                 lengths[i] = T
                 req.admit_time = self._now()
             t0 = self._now()
-            logits, pre_cache = eng._jit_prefill_at(
-                eng.params, jnp.asarray(ids), jnp.asarray(last_pos))
-            self.pool.admit_rows(pre_cache, slots, lengths)
-            tokens = self._sample(logits)  # device sync: tokens exist
+            with self.tracer.span("serving/prefill_batch", n=n, width=width,
+                                  batch=nB):
+                logits, pre_cache = eng._jit_prefill_at(
+                    eng.params, jnp.asarray(ids), jnp.asarray(last_pos))
+                self.pool.admit_rows(pre_cache, slots, lengths)
+                with self.tracer.span("serving/sample"):
+                    tokens = self._sample(logits)  # device sync
             now = self._now()
             self.metrics.record_prefill(int(lengths.sum()), now - t0,
                                         blocking=running_before > 0)
@@ -333,6 +424,10 @@ class ServingEngine:
                 req.state = RequestState.RUNNING
                 req.output_tokens.append(token)
                 self._current[slot] = token
+                self.timelines.record(req.request_id, "admitted", slot=slot,
+                                      mode="batched")
+                self.timelines.record(req.request_id, "first_token")
+                self.tracer.flow("s", "req", req.request_id)
                 self._maybe_retire(req, token, finished)
         except Exception:
             # roll the whole group back to clean QUEUED requests so
@@ -366,13 +461,19 @@ class ServingEngine:
         ids[0, :L] = np.asarray(req.prompt, np.int32)[pos:pos + L]
         running_before = self._running_count()
         t0 = self._now()
-        logits, cache = self.engine.prefill_chunk(
-            self.pool.cache, ids, slot, pos, L, L - 1)
+        with self.tracer.span("serving/prefill_chunk", rid=req.request_id,
+                              pos=pos, len=L):
+            logits, cache = self.engine.prefill_chunk(
+                self.pool.cache, ids, slot, pos, L, L - 1)
         self.pool.cache = cache
         self.pool.starts[slot] = pos + L  # device index moved in-program
         req.prefill_pos = pos + L
+        req.chunks += 1
+        self.timelines.record(req.request_id, "prefill_chunk", pos=pos,
+                              len=L)
         if req.prefill_pos >= req.prompt_len:
-            token = int(self._sample(logits)[0])  # device sync
+            with self.tracer.span("serving/sample"):
+                token = int(self._sample(logits)[0])  # device sync
             now = self._now()
             self.metrics.record_prefill(L, now - t0,
                                         blocking=running_before > 0)
@@ -381,6 +482,7 @@ class ServingEngine:
             req.state = RequestState.RUNNING
             req.output_tokens.append(token)
             self._current[slot] = token
+            self.timelines.record(req.request_id, "first_token")
             self._maybe_retire(req, token, finished)
         else:
             # no sync: the chunk is enqueued and this step's decode
@@ -408,6 +510,13 @@ class ServingEngine:
         self.pool.release(req.slot)
         del self._slot_req[req.slot]
         self.metrics.record_finish(req)
+        self.tracer.flow("f", "req", req.request_id)
+        self.timelines.record(req.request_id, "finished", terminal=True,
+                              reason=req.finish_reason,
+                              new_tokens=len(req.output_tokens),
+                              chunks=req.chunks,
+                              spec_drafted=req.spec_drafted,
+                              spec_accepted=req.spec_accepted)
         finished.append(req)
 
     # ------------------------------------------------------------------
@@ -421,35 +530,47 @@ class ServingEngine:
         requests whose KV state is unrecoverable are FAILED (reason
         ``"error"``), the pool is reset, and the error propagates."""
         finished: List[Request] = []
+        self.step_id += 1
+        self._ensure_watch()      # _jit_verify_k materializes lazily
+        tracer = self.tracer
         t_step = self._now()
         running_at_entry = self._running_count()
-        if self._stall_free:
-            # one chunk for the prefill-queue head will run this step;
-            # pre-charge it so admissions + chunk stay within budget
-            spent = self.prefill_chunk if self._prefill_queue else 0
-            granted = self.scheduler.grant(
-                self.pool.free_count, self.live_count,
-                token_budget=self.prefill_token_budget,
-                cost=self._admission_cost, spent=spent)
-        else:
-            granted = self.scheduler.grant(self.pool.free_count,
-                                           self.live_count)
-        try:
-            if self._stall_free:
-                self._admit_stall_free(granted, finished)
-                self._prefill_chunk_step(finished)
-            else:
-                for req in granted:
-                    self._admit(req, finished)
-            if self._running_count():
-                t0 = self._now()
-                if self._spec is not None:
-                    self._spec_decode_step(finished, t0)
+        with tracer.span("serving/step", step=self.step_id):
+            tracer.counter("serving/occupancy", live=self.live_count,
+                           pending=self.scheduler.pending)
+            with tracer.span("serving/grant"):
+                if self._stall_free:
+                    # one chunk for the prefill-queue head will run this
+                    # step; pre-charge it so admissions + chunk stay
+                    # within budget
+                    spent = self.prefill_chunk if self._prefill_queue else 0
+                    granted = self.scheduler.grant(
+                        self.pool.free_count, self.live_count,
+                        token_budget=self.prefill_token_budget,
+                        cost=self._admission_cost, spent=spent)
                 else:
-                    self._decode_step(finished, t0)
-        except Exception:
-            self._abort_step(granted)
-            raise
+                    granted = self.scheduler.grant(self.pool.free_count,
+                                                   self.live_count)
+            try:
+                if self._stall_free:
+                    self._admit_stall_free(granted, finished)
+                    self._prefill_chunk_step(finished)
+                else:
+                    for req in granted:
+                        self._admit(req, finished)
+                if self._running_count():
+                    t0 = self._now()
+                    if self._spec is not None:
+                        self._spec_decode_step(finished, t0)
+                    else:
+                        self._decode_step(finished, t0)
+            except Exception:
+                self._abort_step(granted)
+                raise
+        # strict-mode recompile gate sits at the step boundary: raising
+        # mid-step would trigger _abort_step and FAIL innocent in-flight
+        # requests, when the state is actually perfectly consistent
+        self.watchdog.check()
         if running_at_entry:
             # a running request waited through this WHOLE step for its
             # next token — the user-visible inter-token gap, admission
@@ -463,8 +584,9 @@ class ServingEngine:
                    if req.state is RequestState.RUNNING]
         tokens = jnp.asarray(self._current[:, None])
         pos = jnp.asarray(self.pool.positions())
-        logits, cache = eng._jit_decode(eng.params, self.pool.cache,
-                                        tokens, pos)
+        with self.tracer.span("serving/decode", live=len(running)):
+            logits, cache = eng._jit_decode(eng.params, self.pool.cache,
+                                            tokens, pos)
         self.pool.cache = cache
         if self._prefill_queue:
             # PREFILLING slots rode along as masked padding: the decode
@@ -477,7 +599,8 @@ class ServingEngine:
             self.pool.advance(deltas)
         else:
             self.pool.advance(1)
-        nxt = self._sample(logits)
+        with self.tracer.span("serving/sample"):
+            nxt = self._sample(logits)  # host sync: tokens exist
         emitted = 0
         for slot, req in running:
             token = int(nxt[slot])
@@ -504,22 +627,26 @@ class ServingEngine:
         for slot, req in self._slot_req.items():
             if req.state is RequestState.RUNNING:
                 histories[slot] = req.tokens()
-        draft, draft_len = self._drafter.propose(histories, K)
+        with self.tracer.span("serving/draft", k=K):
+            draft, draft_len = self._drafter.propose(histories, K)
         draft = np.asarray(draft, np.int32)
         draft_len = np.clip(np.asarray(draft_len, np.int32), 0, K)
         t_draft = self._now() - t0
 
         tokens = np.concatenate([self._current[:, None], draft], axis=1)
         self._rng, sub = jax.random.split(self._rng)
-        cache, out, n_emit = eng.verify_k(
-            self.pool.cache, jnp.asarray(tokens),
-            jnp.asarray(self.pool.positions()), jnp.asarray(draft),
-            jnp.asarray(draft_len), sub,
-            jnp.asarray(self.temperature, jnp.float32), self._greedy,
-            int(self.top_k), float(self.top_p))
+        with self.tracer.span("serving/verify_k", k=K):
+            cache, out, n_emit = eng.verify_k(
+                self.pool.cache, jnp.asarray(tokens),
+                jnp.asarray(self.pool.positions()), jnp.asarray(draft),
+                jnp.asarray(draft_len), sub,
+                jnp.asarray(self.temperature, jnp.float32), self._greedy,
+                int(self.top_k), float(self.top_p))
         self.pool.cache = cache
-        out = np.asarray(out)          # (B, K+1) emitted tokens per row
-        n_emit = np.asarray(n_emit)    # (B,) accepted drafts + 1
+        with self.tracer.span("serving/sample"):
+            # host sync: accepted tokens exist
+            out = np.asarray(out)       # (B, K+1) emitted tokens per row
+            n_emit = np.asarray(n_emit)  # (B,) accepted drafts + 1
 
         deltas = np.zeros((B,), np.int32)
         emitted = drafted = accepted = 0
@@ -534,6 +661,8 @@ class ServingEngine:
             deltas[slot] = e
             drafted += int(draft_len[slot])
             accepted += e - 1
+            req.spec_drafted += int(draft_len[slot])
+            req.spec_accepted += e - 1
             for token in out[slot, :e].tolist():
                 req.output_tokens.append(token)
                 self._current[slot] = token
@@ -554,8 +683,11 @@ class ServingEngine:
         (ahead of the granted ones — they are older); running requests
         lose their (possibly donated-away) KV state and are FAILED; the
         pool restarts from a fresh cache."""
-        self.scheduler.requeue_front(
-            [r for r in granted if r.state is RequestState.QUEUED])
+        requeued = [r for r in granted if r.state is RequestState.QUEUED]
+        self.scheduler.requeue_front(requeued)
+        for req in requeued:
+            self.timelines.record(req.request_id, "requeued",
+                                  reason="admit_error")
         prefilling = sorted(
             (r for r in self._slot_req.values()
              if r.state is RequestState.PREFILLING),
@@ -566,6 +698,8 @@ class ServingEngine:
             req.admit_time = None
             req.prefill_pos = 0
             del req.output_tokens[:]
+            self.timelines.record(req.request_id, "requeued",
+                                  reason="step_error")
         self.scheduler.requeue_front(prefilling)
         self._prefill_queue[:] = []
         for req in self._slot_req.values():
@@ -573,6 +707,8 @@ class ServingEngine:
             req.finish_reason = "error"
             req.finish_time = self._now()
             self.metrics.record_failure(req)
+            self.timelines.record(req.request_id, "failed", terminal=True,
+                                  reason="error")
         self._slot_req.clear()
         self._current[:] = 0
         self.pool.reset()

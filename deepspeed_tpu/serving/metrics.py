@@ -5,10 +5,21 @@ wait, per-token gap) to the aggregate; :meth:`ServingMetrics.snapshot`
 reduces them to the serving-SLO quantiles (p50/p99 TTFT, req/s,
 tokens/s) the benchmark row and dashboards report. When a
 :class:`~deepspeed_tpu.monitor.monitor.Monitor` is attached, each
-retirement writes ``serving/*`` events keyed by request id — the same
-``(tag, value, step)`` event path training metrics use, so the existing
-TensorBoard/W&B/CSV sinks pick serving traffic up with zero new
+retirement writes ``serving/*`` events — the same ``(tag, value,
+step)`` event path training metrics use, so the existing
+TensorBoard/W&B/CSV/JSONL sinks pick serving traffic up with zero new
 plumbing.
+
+Every monitor event carries ONE step axis: the serving engine's
+monotonic step counter (``step_fn``), so rejection, finish, and
+speculative-efficiency series line up across sinks. (They used to mix
+request ids and decode-step counts — useless for correlating a
+rejection burst with the decode stall that caused it.) Standalone
+instances without a ``step_fn`` fall back to ``decode_steps``.
+
+When a :class:`~deepspeed_tpu.telemetry.MetricsRegistry` is attached,
+the same observations also land in Prometheus-exportable
+counters/histograms (``serving/*``).
 """
 
 from __future__ import annotations
@@ -27,8 +38,12 @@ def _pct(values: List[float], q: float) -> Optional[float]:
 class ServingMetrics:
     """Accumulates finished/rejected requests; reduces to SLO aggregates."""
 
-    def __init__(self, monitor: Optional[Any] = None):
+    def __init__(self, monitor: Optional[Any] = None,
+                 registry: Optional[Any] = None,
+                 step_fn: Optional[Any] = None):
         self.monitor = monitor
+        self.registry = registry
+        self._step_fn = step_fn
         self.finished: List[Request] = []
         self.rejected: Dict[str, int] = {}
         self.failed: int = 0
@@ -59,19 +74,34 @@ class ServingMetrics:
         self.step_gaps: List[float] = []
 
     # ------------------------------------------------------------------
+    def _step(self) -> int:
+        """The shared step axis for every monitor event (see module doc)."""
+        return int(self._step_fn()) if self._step_fn is not None \
+            else self.decode_steps
+
+    def _inc(self, name: str, amount: float = 1.0) -> None:
+        if self.registry is not None:
+            self.registry.counter(name).inc(amount)
+
+    def _observe_ms(self, name: str, seconds: float) -> None:
+        if self.registry is not None:
+            self.registry.histogram(name).observe(seconds * 1e3)
+
     def record_rejection(self, req: Request) -> None:
         reason = req.reject_reason or "unknown"
         self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        self._inc(f"serving/rejected/{reason}")
         if self.monitor is not None and getattr(self.monitor, "enabled", True):
             self.monitor.write_events([
-                (f"serving/rejected/{reason}", 1.0, req.request_id)])
+                (f"serving/rejected/{reason}", 1.0, self._step())])
 
     def record_failure(self, req: Request) -> None:
         """A running request killed by a mid-step engine exception."""
         self.failed += 1
+        self._inc("serving/failed")
         if self.monitor is not None and getattr(self.monitor, "enabled", True):
             self.monitor.write_events([
-                ("serving/failed", 1.0, req.request_id)])
+                ("serving/failed", 1.0, self._step())])
 
     def record_decode_step(self, emitted: int, live_slots: int,
                            drafted: int = 0, accepted: int = 0,
@@ -86,19 +116,21 @@ class ServingMetrics:
         self.accepted_drafts += accepted
         self.draft_time += draft_s
         self.step_time += step_s
+        self._inc("serving/decode_tokens", emitted)
         if drafted and self.monitor is not None and \
                 getattr(self.monitor, "enabled", True):
+            step = self._step()
             self.monitor.write_events([
-                ("serving/spec_acceptance", accepted / drafted,
-                 self.decode_steps),
+                ("serving/spec_acceptance", accepted / drafted, step),
                 ("serving/spec_tokens_per_slot_step",
-                 emitted / max(live_slots, 1), self.decode_steps),
+                 emitted / max(live_slots, 1), step),
             ])
 
     def record_step_gap(self, seconds: float) -> None:
         """One full scheduler step during which at least one RUNNING
         request was waiting on its next token (see ``step_gaps``)."""
         self.step_gaps.append(seconds)
+        self._observe_ms("serving/step_gap_ms", seconds)
 
     def record_prefill(self, tokens: int, seconds: float,
                        blocking: bool) -> None:
@@ -108,25 +140,34 @@ class ServingMetrics:
         self.prefill_tokens += tokens
         self.prefill_dispatches += 1
         self.prefill_time += seconds
+        self._inc("serving/prefill_tokens", tokens)
         if blocking:
             self.stall_time += seconds
 
     def record_finish(self, req: Request) -> None:
         self.finished.append(req)
+        self._inc("serving/finished")
+        if req.ttft is not None:
+            self._observe_ms("serving/ttft_ms", req.ttft)
+        if req.queue_wait is not None:
+            self._observe_ms("serving/queue_wait_ms", req.queue_wait)
+        if req.per_token_latency is not None:
+            self._observe_ms("serving/per_token_ms", req.per_token_latency)
         if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            step = self._step()
             if req.finish_reason == "length_cap":
                 # a slot hit the allocated max_seq_len mid-generation —
                 # ops-worthy (capacity sizing), so it gets its own event
                 self.monitor.write_events([
-                    ("serving/finished/length_cap", 1.0, req.request_id)])
+                    ("serving/finished/length_cap", 1.0, step)])
             self.monitor.write_events([
-                ("serving/ttft_ms", (req.ttft or 0.0) * 1e3, req.request_id),
+                ("serving/ttft_ms", (req.ttft or 0.0) * 1e3, step),
                 ("serving/queue_wait_ms", (req.queue_wait or 0.0) * 1e3,
-                 req.request_id),
+                 step),
                 ("serving/per_token_ms", (req.per_token_latency or 0.0) * 1e3,
-                 req.request_id),
+                 step),
                 ("serving/new_tokens", float(len(req.output_tokens)),
-                 req.request_id),
+                 step),
             ])
 
     # ------------------------------------------------------------------

@@ -54,6 +54,12 @@ class Request:
     #                                         into the slot (chunked prefill)
     output_tokens: List[int] = dataclasses.field(default_factory=list)
 
+    # telemetry counters (per-request lifecycle accounting)
+    chunks: int = 0                         # chunked-prefill dispatches run
+    spec_drafted: int = 0                   # draft tokens proposed for this
+    #                                         request's slot
+    spec_accepted: int = 0                  # draft tokens accepted
+
     submit_time: Optional[float] = None
     admit_time: Optional[float] = None      # prefill issued (slot granted)
     first_token_time: Optional[float] = None

@@ -26,19 +26,27 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel import mesh as mesh_mod
 
 
 class SlotPool:
     """``num_slots`` independently-occupied rows of one shared KV cache."""
 
-    def __init__(self, spec: Any, num_slots: int):
+    def __init__(self, spec: Any, num_slots: int, sharding: Any = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.spec = spec
         self.num_slots = num_slots
         self.capacity = int(spec.max_seq_len)
+        # replicated sharding the owning engine's jitted steps emit;
+        # falls back to the global mesh for standalone pools
+        if sharding is None and mesh_mod.has_mesh():
+            sharding = NamedSharding(mesh_mod.get_mesh(), PartitionSpec())
+        self._sharding = sharding
         # the flax "cache" collection pytree the engine's decode consumes
-        self.cache: Dict[str, Any] = {"cache_store": spec.stacked_cache(num_slots)}
+        self.cache: Dict[str, Any] = self._fresh_cache()
         # host mirror of the per-slot cache index (device truth lives in
         # cache["cache_store"]["index"]); decode needs the (B,) positions
         # each step and reading them back from device would sync
@@ -53,6 +61,33 @@ class SlotPool:
         # (L, num_slots, ...) outputs, so donating it only warns
         self._admit_jit = jax.jit(self._admit_row, donate_argnums=(0,))
         self._admit_rows_jit = jax.jit(self._admit_rows, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    def _fresh_cache(self) -> Dict[str, Any]:
+        """Zeroed pool pytree, committed to the replicated sharding the
+        engine's jitted steps emit. A bare ``jnp.zeros`` pool is
+        UNCOMMITTED, so the first admission would compile against
+        ``UnspecifiedValue`` input shardings — one executable for the
+        cold pool and a second once decode outputs (NamedSharding-
+        committed) flow back in as the donated pool argument. Committing
+        up front keeps each admit jit at exactly one executable for the
+        pool's lifetime (the recompile watchdog pins this)."""
+        cache = {"cache_store": self.spec.stacked_cache(self.num_slots)}
+        if self._sharding is not None:
+            cache = jax.device_put(cache, self._sharding)
+        return cache
+
+    def _index_from_mirror(self):
+        """Device ``index`` rebuilt from the host mirror, committed like
+        every other pool leaf (see :meth:`_fresh_cache` — a bare
+        ``jnp.asarray`` would flip the leaf back to uncommitted and
+        fork the admit/decode executables on sharding mismatch)."""
+        # explicit copy: the CPU backend may zero-copy a numpy buffer,
+        # and the mirror is mutated in place by later advance() calls
+        idx = jnp.array(self.starts, copy=True)
+        if self._sharding is not None:
+            idx = jax.device_put(idx, self._sharding)
+        return idx
 
     # ------------------------------------------------------------------
     @property
@@ -91,7 +126,7 @@ class SlotPool:
         cache. Used after a mid-step exception — a failed dispatch may
         have consumed the donated cache buffers, so the old pytree can't
         be trusted (or even alive) afterwards."""
-        self.cache = {"cache_store": self.spec.stacked_cache(self.num_slots)}
+        self.cache = self._fresh_cache()
         self.starts[:] = 0
         self._free = list(range(self.num_slots))
         heapq.heapify(self._free)
@@ -105,7 +140,7 @@ class SlotPool:
         masking and gets overwritten chunk by chunk."""
         self.starts[slot] = 0
         cs = dict(self.cache["cache_store"])
-        cs["index"] = jnp.asarray(self.starts)
+        cs["index"] = self._index_from_mirror()
         self.cache = {"cache_store": cs}
 
     # ------------------------------------------------------------------
@@ -195,7 +230,7 @@ class SlotPool:
                              f"({self.num_slots},)")
         self.starts += lengths
         cs = dict(self.cache["cache_store"])
-        cs["index"] = jnp.asarray(self.starts)
+        cs["index"] = self._index_from_mirror()
         self.cache = {"cache_store": cs}
 
     def positions(self) -> np.ndarray:

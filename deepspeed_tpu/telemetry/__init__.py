@@ -1,0 +1,66 @@
+"""Telemetry: structured tracing, per-request timelines, recompile
+watchdog, and a Prometheus-exportable metrics registry.
+
+This package is the TPU-idiomatic analogue of the reference
+DeepSpeed's observability stack, mapped feature-for-feature:
+
+* reference ``utils/timer.py`` (SynchronizedWallClockTimer) →
+  :class:`Tracer` spans. The reference synchronizes CUDA before
+  reading the clock; here the analogous hazard is JAX *async
+  dispatch* — a host-side timer around a jitted call measures
+  dispatch, not compute. Spans record honest host time; for compute
+  time, pass the step outputs to ``Timer.stop(block_on=...)``
+  (see ``utils/timer.py``) which ``block_until_ready``-s them first.
+* reference ``monitor/`` (TensorBoard/WandB/csv scalar sinks) →
+  :class:`MetricsRegistry` publishing ``(tag, value, step)`` events
+  through the same ``MonitorMaster`` fan-out, plus the new machine-
+  readable ``JSONLMonitor`` sink and Prometheus text exposition via
+  :meth:`MetricsRegistry.to_prometheus`.
+* reference ``flops_profiler`` (per-module latency breakdown) →
+  step-phase spans inside ``ServingEngine.step()`` /
+  ``DeepSpeedEngine.train_batch()`` exported as a Chrome
+  trace-event / Perfetto JSON timeline (:meth:`Tracer.export`),
+  including per-request lifecycle lanes (:class:`TimelineStore`) —
+  per-iteration attribution rather than per-module FLOPs, because on
+  TPU the profiler of record for intra-step FLOPs is XLA's own.
+* no reference analogue: :class:`RecompileWatchdog`. XLA recompilation
+  is the TPU-specific production hazard (a shape-churned serving step
+  silently costs seconds); the watchdog attributes every recompile to
+  a jitted program + abstract shape signature, and ``strict`` mode
+  turns the tests' "zero recompiles under churn" invariant into a
+  runtime guarantee.
+
+Quick start::
+
+    from deepspeed_tpu.telemetry import Tracer
+    tracer = Tracer()
+    with tracer.span("serving/step", step=3):
+        ...
+    tracer.export("/tmp/trace.json")   # open in ui.perfetto.dev
+
+Serving integration (all knobs on ``ds.init_serving``)::
+
+    srv = ds.init_serving(engine, tracer=Tracer(),
+                          strict_recompile=True)
+    srv.end_warmup()            # after warmup traffic
+    srv.timeline(request_id)    # per-request lifecycle events
+    srv.publish_telemetry()     # registry -> monitor sinks
+"""
+
+from .tracer import Tracer
+from .registry import Counter, Gauge, Histogram, MetricsRegistry
+from .timeline import TimelineStore
+from .watchdog import (RecompileAfterWarmupError, RecompileWatchdog,
+                       abstract_signature)
+
+__all__ = [
+    "Tracer",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "TimelineStore",
+    "RecompileWatchdog",
+    "RecompileAfterWarmupError",
+    "abstract_signature",
+]

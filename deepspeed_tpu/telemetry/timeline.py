@@ -1,0 +1,82 @@
+"""Per-request lifecycle timelines.
+
+Every :class:`~deepspeed_tpu.serving.request.Request` state transition
+is recorded as a timestamped event keyed by request id — submission,
+rejection (with reason), admission, each prefill chunk, first token,
+speculative accept counts, retirement (with reason), failure, requeue.
+The store is host-side and bounded (oldest requests are evicted once
+``capacity`` distinct ids have been seen) so it is always on, even
+when tracing is off.
+
+When a tracer is attached, each timeline is mirrored as a Chrome
+async-nestable track (``ph`` ``b``/``n``/``e``, ``cat="request"``,
+``id=request_id``) so per-request lanes render alongside the engine
+step spans in Perfetto, and terminal events carry the accumulated
+chunk/spec counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+
+class TimelineStore:
+    """Bounded request-id → event-list map, mirrored into a tracer."""
+
+    def __init__(self, capacity: int = 4096, tracer=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.tracer = tracer
+        self._lock = threading.Lock()
+        # rid -> {"events": [...], "open": bool}
+        self._timelines: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+
+    def record(self, request_id: int, event: str,
+               terminal: bool = False, **attrs) -> None:
+        now = time.perf_counter_ns()
+        with self._lock:
+            tl = self._timelines.get(request_id)
+            fresh = tl is None
+            if fresh:
+                tl = {"events": [], "open": True,
+                      "wall_start": time.time()}
+                self._timelines[request_id] = tl
+                while len(self._timelines) > self.capacity:
+                    self._timelines.popitem(last=False)
+            tl["events"].append(
+                {"event": event, "t_ns": now, "attrs": attrs or None})
+            was_open = tl["open"]
+            if terminal:
+                tl["open"] = False
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            track = f"req-{request_id}"
+            if fresh:
+                tr.async_begin("request", track, request_id, event=event,
+                               **attrs)
+            if not fresh or attrs:
+                tr.async_instant("request", event, request_id, **attrs)
+            if terminal and was_open:
+                tr.async_end("request", track, request_id, event=event,
+                             **attrs)
+
+    def get(self, request_id: int) -> Optional[List[Dict[str, Any]]]:
+        """Events for one request, oldest first, or None if evicted/unknown."""
+        with self._lock:
+            tl = self._timelines.get(request_id)
+            if tl is None:
+                return None
+            return list(tl["events"])
+
+    def events_of(self, request_id: int) -> List[str]:
+        """Just the event names, for terse assertions."""
+        tl = self.get(request_id)
+        return [e["event"] for e in tl] if tl else []
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._timelines)

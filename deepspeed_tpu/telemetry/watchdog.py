@@ -1,0 +1,270 @@
+"""Recompile watchdog: turn "zero recompiles under churn" into a
+runtime counter.
+
+The serving tests pin the invariant that slot churn never triggers XLA
+recompilation by diffing ``jitted._cache_size()`` before/after a wave.
+This module promotes that into production telemetry:
+
+* a process-global ``jax.monitoring`` duration listener counts every
+  backend compile (``backend_compiles``, unattributed — JAX fires it
+  for any program in the process);
+* :class:`_WatchedJit` proxies wrap the named jitted entry points
+  (``InferenceEngine._jit_*``, ``SlotPool._admit*_jit``); a call during
+  which the global compile counter advanced is attributed a recompile
+  under the program name plus the abstract shape signature of the
+  offending call (``recompiles`` — the headline counter, counted after
+  warmup).
+
+Detection deliberately keys on the *backend compile* event, not on
+``jitted._cache_size()`` growth: the C++ fastpath cache adds entries
+for identical avals (e.g. numpy-backed vs device-resident inputs)
+without lowering or compiling anything, so cache growth over-reports.
+The compile-window attribution assumes watched programs are not called
+concurrently from multiple threads (true for the serving/step loop);
+a concurrent unrelated compile would at worst mislabel, never
+undercount.
+
+Each detection emits a ``telemetry/recompile`` event into the tracer,
+registry, and monitor sinks. ``strict`` mode arms
+:meth:`RecompileWatchdog.check` to raise
+:class:`RecompileAfterWarmupError` — callers invoke it *between*
+steps so an unexpected recompile aborts cleanly instead of corrupting
+in-flight state.
+
+``jax.monitoring`` listeners are global and cannot be removed
+individually, so exactly one module-level listener is registered and
+dispatches to a ``WeakSet`` of live watchdogs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+try:  # pragma: no cover - jax is always present in this repo
+    from jax import monitoring as _jax_monitoring
+    from jax import tree_util as _jax_tree_util
+except Exception:  # pragma: no cover
+    _jax_monitoring = None
+    _jax_tree_util = None
+
+
+class RecompileAfterWarmupError(RuntimeError):
+    """Raised by strict-mode watchdogs when a warmed program recompiles."""
+
+
+# ----------------------------------------------------------------------
+# shape signatures
+# ----------------------------------------------------------------------
+def _sig_one(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(map(str, shape))}]"
+    if isinstance(x, (list, tuple, dict)):
+        leaves: List[Any] = []
+        if _jax_tree_util is not None:
+            try:
+                leaves = _jax_tree_util.tree_leaves(x)
+            except Exception:
+                leaves = []
+        if leaves:
+            return f"tree({len(leaves)} leaves, first={_sig_one(leaves[0])})"
+        return f"{type(x).__name__}()"
+    if isinstance(x, (bool, int, float, str)) or x is None:
+        return repr(x)
+    return type(x).__name__
+
+
+def abstract_signature(args: tuple, kwargs: Dict[str, Any]) -> str:
+    """Cheap human-readable abstraction of a call's arg shapes.
+
+    Only computed when a recompile was already detected, so it can
+    afford the pytree walk.
+    """
+    parts = [_sig_one(a) for a in args]
+    parts += [f"{k}={_sig_one(v)}" for k, v in sorted(kwargs.items())]
+    return "(" + ", ".join(parts) + ")"
+
+
+# ----------------------------------------------------------------------
+# per-program proxies
+# ----------------------------------------------------------------------
+class _WatchedJit:
+    """Transparent wrapper over a jitted callable: a call during which
+    the process-wide backend-compile counter advanced is reported to
+    the watchers as a recompile of this program.
+
+    Attribute access falls through to the wrapped function, so
+    existing ``fn._cache_size()`` call sites keep working whether or
+    not the attribute has been wrapped. Non-jit callables (tests
+    inject plain lambdas) trigger no compiles and pass through
+    without bookkeeping.
+    """
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self._name = name
+        self._watchers: "weakref.WeakSet[RecompileWatchdog]" = \
+            weakref.WeakSet()
+        _ensure_listener()
+
+    def __call__(self, *args, **kwargs):
+        start = _compile_events
+        out = self._fn(*args, **kwargs)
+        if _compile_events > start and self._watchers:
+            sig = abstract_signature(args, kwargs)
+            for w in list(self._watchers):
+                w.record(self._name, sig)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+    def __repr__(self):
+        return f"_WatchedJit({self._name}, {self._fn!r})"
+
+
+# ----------------------------------------------------------------------
+# global jax.monitoring listener
+# ----------------------------------------------------------------------
+_active_watchdogs: "weakref.WeakSet[RecompileWatchdog]" = weakref.WeakSet()
+_listener_lock = threading.Lock()
+_listener_registered = False
+# process-wide backend-compile tick; _WatchedJit snapshots it around
+# each call to attribute compiles to the program that triggered them
+_compile_events = 0
+
+
+def _on_event_duration(event: str, duration: float, **kw) -> None:
+    global _compile_events
+    if "backend_compile" in event:
+        _compile_events += 1
+        for w in list(_active_watchdogs):
+            w._record_backend_compile(event, duration)
+
+
+def _ensure_listener() -> None:
+    global _listener_registered
+    with _listener_lock:
+        if _listener_registered or _jax_monitoring is None:
+            return
+        _jax_monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        _listener_registered = True
+
+
+# ----------------------------------------------------------------------
+# watchdog
+# ----------------------------------------------------------------------
+class RecompileWatchdog:
+    """Counts and attributes recompiles; optionally raises after warmup.
+
+    Lifecycle: construct → :meth:`attach` the jitted entry points →
+    run warmup traffic → :meth:`end_warmup` → steady state. Recompiles
+    recorded before ``end_warmup()`` land in ``warmup_recompiles``;
+    after it they land in the headline ``recompiles`` counter, and in
+    ``strict`` mode the next :meth:`check` raises.
+    """
+
+    def __init__(self, registry=None, tracer=None, monitor=None,
+                 strict: bool = False, step_fn=None, name: str = ""):
+        self.registry = registry
+        self.tracer = tracer
+        self.monitor = monitor
+        self.strict = strict
+        self.name = name
+        self._step_fn = step_fn or (lambda: 0)
+        self._warmed = False
+        self.warmup_recompiles = 0
+        self._post_warmup = 0
+        self._raised_at = 0
+        self.backend_compiles = 0
+        self.events: List[Dict[str, Any]] = []
+        _active_watchdogs.add(self)
+        _ensure_listener()
+
+    # -- wiring --------------------------------------------------------
+    def attach(self, owner: Any, attr: str,
+               name: Optional[str] = None) -> Optional[_WatchedJit]:
+        """Wrap ``owner.attr`` (idempotent; proxies are shared across
+        watchdogs so a jitted entry is never double-wrapped)."""
+        fn = getattr(owner, attr, None)
+        if fn is None:
+            return None
+        if isinstance(fn, _WatchedJit):
+            proxy = fn
+        else:
+            proxy = _WatchedJit(
+                fn, name or f"{type(owner).__name__}.{attr}")
+            setattr(owner, attr, proxy)
+        proxy._watchers.add(self)
+        return proxy
+
+    def attach_all(self, owner: Any, attrs) -> None:
+        for attr in attrs:
+            self.attach(owner, attr)
+
+    # -- recording -----------------------------------------------------
+    def record(self, program: str, signature: str) -> None:
+        warmup = not self._warmed
+        self.events.append({
+            "program": program, "signature": signature,
+            "warmup": warmup, "time": time.time(),
+        })
+        if warmup:
+            self.warmup_recompiles += 1
+        else:
+            self._post_warmup += 1
+        if self.registry is not None:
+            key = "telemetry/recompiles_warmup" if warmup \
+                else "telemetry/recompiles"
+            self.registry.counter(key).inc()
+        if self.tracer is not None:
+            self.tracer.instant("telemetry/recompile", program=program,
+                                signature=signature, warmup=warmup)
+        mon = self.monitor
+        if mon is not None and getattr(mon, "enabled", False) and not warmup:
+            mon.write_events([("telemetry/recompile",
+                               float(self._post_warmup),
+                               int(self._step_fn()))])
+
+    def _record_backend_compile(self, event: str, duration: float) -> None:
+        self.backend_compiles += 1
+        if self.registry is not None:
+            self.registry.counter("telemetry/backend_compiles").inc()
+
+    # -- lifecycle -----------------------------------------------------
+    def end_warmup(self) -> None:
+        self._warmed = True
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    @property
+    def recompiles(self) -> int:
+        """Attributed recompiles observed after :meth:`end_warmup`."""
+        return self._post_warmup
+
+    def check(self) -> None:
+        """Raise (strict mode only) if a warmed program recompiled since
+        the last check. Call between steps, never inside a step."""
+        if (self.strict and self._warmed
+                and self._post_warmup > self._raised_at):
+            new = self.events[-1] if self.events else {}
+            self._raised_at = self._post_warmup
+            raise RecompileAfterWarmupError(
+                f"recompile after warmup ({self._post_warmup} total): "
+                f"{new.get('program', '?')} {new.get('signature', '')}")
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "recompiles": self._post_warmup,
+            "warmup_recompiles": self.warmup_recompiles,
+            "backend_compiles": self.backend_compiles,
+            "warmed": self._warmed,
+            "programs": sorted({e["program"] for e in self.events}),
+        }

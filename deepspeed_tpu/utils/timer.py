@@ -1,9 +1,23 @@
 """Wall-clock and throughput timers.
 
 Capability parity with reference ``deepspeed/utils/timer.py`` —
-``SynchronizedWallClockTimer`` (:33) and ``ThroughputTimer`` (:153). On TPU,
-"synchronized" means draining the async dispatch queue
-(``block_until_ready``) instead of cuda events.
+``SynchronizedWallClockTimer`` (:33) and ``ThroughputTimer`` (:153).
+
+**Dispatch vs compute.** JAX dispatch is asynchronous: a jitted call
+returns as soon as the program is enqueued, so a host-side timer around
+it measures *dispatch*, not compute. The accelerator's bare
+``synchronize()`` (no tensors) only round-trips a tiny transfer, which
+does NOT wait for enqueued compute — the reference's
+``cuda.synchronize()`` has no cheap TPU analogue. Timers that wrap
+jitted calls must therefore pass the call's outputs to
+``stop(block_on=...)``, which ``jax.block_until_ready``-s them before
+reading the clock. Construct the timer with ``barrier=True`` to make
+that mandatory: a ``stop()`` without ``block_on`` then raises instead
+of silently recording a dispatch time.
+
+``SynchronizedWallClockTimer.publish(registry)`` drains every timer's
+recorded intervals into ``timer/{name}_ms`` histograms on a
+:class:`~deepspeed_tpu.telemetry.MetricsRegistry`.
 """
 
 from __future__ import annotations
@@ -24,8 +38,9 @@ TRAIN_BATCH_TIMER = "train_batch"
 
 class SynchronizedWallClockTimer:
     class Timer:
-        def __init__(self, name: str):
+        def __init__(self, name: str, barrier: bool = False):
             self.name_ = name
+            self.barrier = barrier
             self.elapsed_ = 0.0
             self.started_ = False
             self.start_time = 0.0
@@ -45,9 +60,25 @@ class SynchronizedWallClockTimer:
             self.start_time = time.time()
             self.started_ = True
 
-        def stop(self, reset: bool = False, record: bool = True):
+        def stop(self, reset: bool = False, record: bool = True,
+                 block_on=None):
+            """Stop the timer. ``block_on`` takes the jitted call's
+            outputs (any pytree) and waits for them to actually exist
+            before reading the clock — without it, async dispatch makes
+            the recorded interval a dispatch time (see module doc).
+            ``barrier=True`` timers refuse to record without it."""
             assert self.started_, "timer is not started"
-            self._sync()
+            if block_on is not None:
+                import jax
+
+                jax.block_until_ready(block_on)
+            elif self.barrier and record:
+                raise RuntimeError(
+                    f"timer '{self.name_}' was constructed with "
+                    f"barrier=True: stop() needs block_on=<jitted "
+                    f"outputs>, otherwise it times dispatch, not compute")
+            else:
+                self._sync()
             elapsed = time.time() - self.start_time
             if reset:
                 self.elapsed_ = elapsed
@@ -78,10 +109,28 @@ class SynchronizedWallClockTimer:
     def __init__(self):
         self.timers: Dict[str, SynchronizedWallClockTimer.Timer] = {}
 
-    def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
+    def __call__(self, name: str,
+                 barrier: bool = False) -> "SynchronizedWallClockTimer.Timer":
         if name not in self.timers:
-            self.timers[name] = self.Timer(name)
+            self.timers[name] = self.Timer(name, barrier=barrier)
         return self.timers[name]
+
+    def publish(self, registry, clear: bool = True) -> int:
+        """Drain every timer's recorded intervals into ``timer/{name}_ms``
+        histograms on a telemetry ``MetricsRegistry``; returns the number
+        of observations moved (drained so repeat publishes never
+        double-count)."""
+        moved = 0
+        for name, timer in self.timers.items():
+            if not timer.records:
+                continue
+            hist = registry.histogram(f"timer/{name}_ms")
+            for ms in timer.records:
+                hist.observe(ms)
+            moved += len(timer.records)
+            if clear:
+                del timer.records[:]
+        return moved
 
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
             memory_breakdown: bool = False, ranks: Optional[List[int]] = None):
@@ -138,7 +187,10 @@ class ThroughputTimer:
                 pass
             self.start_time = time.time()
 
-    def stop(self, global_step: bool = False, report_speed: bool = True):
+    def stop(self, global_step: bool = False, report_speed: bool = True,
+             block_on=None):
+        """``block_on`` — the step's jitted outputs; waits for compute to
+        finish before reading the clock (see module doc on dispatch)."""
         if not self.started:
             return
         self.started = False
@@ -146,12 +198,17 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0 and self.global_step_count > self.start_step:
-            from ..accelerator import get_accelerator
+            if block_on is not None:
+                import jax
 
-            try:
-                get_accelerator().synchronize()
-            except Exception:
-                pass
+                jax.block_until_ready(block_on)
+            else:
+                from ..accelerator import get_accelerator
+
+                try:
+                    get_accelerator().synchronize()
+                except Exception:
+                    pass
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration

@@ -97,6 +97,7 @@ def test_slot_reuse_does_not_recompile(stack):
     srv.run_until_drained(max_steps=50)
     n_decode = engine._jit_decode._cache_size()
     n_prefill = engine._jit_prefill_at._cache_size()
+    srv.end_warmup()  # arm the watchdog's post-warmup counter
 
     for _ in range(5):  # wave B: same buckets through reused slots
         srv.submit(rng.integers(0, 64, size=6).astype(np.int32),
@@ -104,6 +105,7 @@ def test_slot_reuse_does_not_recompile(stack):
     srv.run_until_drained(max_steps=100)
     assert engine._jit_decode._cache_size() == n_decode
     assert engine._jit_prefill_at._cache_size() == n_prefill
+    assert srv.watchdog.recompiles == 0
 
 
 def test_admission_control_rejects_with_reason(stack):

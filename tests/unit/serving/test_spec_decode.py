@@ -137,15 +137,23 @@ def test_spec_churn_does_not_recompile(stack):
         for p in _mixed_prompts(rng, n):
             srv.submit(p, max_new_tokens=5)
         srv.run_until_drained(max_steps=200)
+        return srv
 
     wave(2)  # compile: prefill buckets, verify, decode
     n_verify = engine._jit_verify_k._cache_size()
     n_decode = engine._jit_decode._cache_size()
     n_prefill = engine._jit_prefill_at._cache_size()
-    wave(6)  # multi-wave churn through the same shapes
+    srv = wave(6)  # multi-wave churn through the same shapes
     assert engine._jit_verify_k._cache_size() == n_verify
     assert engine._jit_decode._cache_size() == n_decode
     assert engine._jit_prefill_at._cache_size() == n_prefill
+    # the watchdog pins the same invariant at runtime: a warmed server
+    # sees zero attributed compiles through another churn wave
+    srv.end_warmup()
+    for p in _mixed_prompts(rng, 4):
+        srv.submit(p, max_new_tokens=5)
+    srv.run_until_drained(max_steps=200)
+    assert srv.watchdog.recompiles == 0
 
 
 def test_capacity_margin_tightens_admission(stack):

@@ -252,6 +252,7 @@ def test_no_recompile_across_chunked_and_batched_churn(stack):
     n_decode = engine._jit_decode._cache_size()
     n_prefill = engine._jit_prefill_at._cache_size()
     n_chunk = engine._jit_prefill_chunk._cache_size()
+    srv.end_warmup()  # arm the watchdog's post-warmup counter
 
     # churn: different prompt lengths in the same buckets, different
     # chunk counts/final-tail widths, reused slots
@@ -262,6 +263,7 @@ def test_no_recompile_across_chunked_and_batched_churn(stack):
     assert engine._jit_decode._cache_size() == n_decode
     assert engine._jit_prefill_at._cache_size() == n_prefill
     assert engine._jit_prefill_chunk._cache_size() == n_chunk
+    assert srv.watchdog.recompiles == 0
 
 
 def test_config_validation_and_fallbacks(stack):

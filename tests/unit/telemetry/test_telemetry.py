@@ -1,0 +1,464 @@
+"""Telemetry subsystem tests: ring-buffer tracer semantics (wraparound,
+thread safety, Perfetto-loadable export), metrics registry + Prometheus
+exposition, recompile watchdog attribution/strict mode, dispatch-aware
+timers, the JSONL monitor sink, and pipeline schedule tracing."""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import (
+    MetricsRegistry,
+    RecompileAfterWarmupError,
+    RecompileWatchdog,
+    TimelineStore,
+    Tracer,
+    abstract_signature,
+)
+
+
+class _FakeMonitor:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, events):
+        self.events.extend(events)
+
+
+# ----------------------------------------------------------------------
+# tracer
+# ----------------------------------------------------------------------
+class TestTracer:
+    def test_ring_buffer_wraparound_keeps_newest_oldest_first(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            tr.instant(f"ev-{i}")
+        evs = tr.events()
+        assert len(evs) == 8
+        assert tr.events_total == 20
+        # the window holds the 8 newest events, oldest first
+        assert [e["name"] for e in evs] == [f"ev-{i}" for i in range(12, 20)]
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)
+
+    def test_span_records_duration_and_attrs(self):
+        tr = Tracer()
+        with tr.span("work", phase="x") as sp:
+            sp.set(extra=3)
+        (ev,) = tr.events()
+        assert ev["ph"] == "X" and ev["name"] == "work"
+        assert ev["dur"] >= 0
+        assert ev["args"] == {"phase": "x", "extra": 3}
+
+    def test_span_records_error_class_on_exception(self):
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("nope")
+        (ev,) = tr.events()
+        assert ev["args"]["error"] == "ValueError"
+
+    def test_disabled_tracer_is_null(self):
+        tr = Tracer(enabled=False)
+        with tr.span("x") as sp:
+            sp.set(a=1)  # null span absorbs attrs
+        tr.instant("y")
+        tr.counter("z", v=1)
+        tr.async_begin("c", "n", 0)
+        tr.flow("s", "f", 0)
+        assert tr.events() == [] and tr.events_total == 0
+
+    def test_trace_decorator(self):
+        tr = Tracer()
+
+        @tr.trace("decorated")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        assert tr.events()[0]["name"] == "decorated"
+
+    def test_thread_safety_under_concurrent_spans(self):
+        tr = Tracer(capacity=100_000)
+        n_threads, n_spans = 8, 200
+        errors = []
+
+        def worker(k):
+            try:
+                for i in range(n_spans):
+                    with tr.span(f"t{k}", i=i):
+                        pass
+                    tr.counter(f"c{k}", v=i)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert tr.events_total == n_threads * n_spans * 2
+        assert len(tr.events()) == n_threads * n_spans * 2
+
+    def test_chrome_export_schema(self, tmp_path):
+        tr = Tracer(process_name="test-proc")
+        with tr.span("outer", step=1):
+            with tr.span("inner"):
+                pass
+        tr.counter("slots", live=2)
+        tr.async_begin("request", "req-1", 1, event="submitted")
+        tr.async_instant("request", "first_token", 1)
+        tr.async_end("request", "req-1", 1)
+        tr.flow("s", "req", 1)
+        tr.flow("f", "req", 1)
+
+        path = tmp_path / "trace.json"
+        n = tr.export(str(path))
+        doc = json.loads(path.read_text())  # valid JSON round-trip
+        evs = doc["traceEvents"]
+        assert n == len(evs)
+        phs = {e["ph"] for e in evs}
+        assert {"X", "C", "b", "n", "e", "s", "f", "M"} <= phs
+        for e in evs:
+            assert isinstance(e["name"], str) and "pid" in e and "tid" in e
+            if e["ph"] != "M":
+                assert e["ts"] >= 0  # µs, normalized to window start
+        flow_f = [e for e in evs if e["ph"] == "f"]
+        assert flow_f and all(e["bp"] == "e" for e in flow_f)
+        names = [e["args"]["name"] for e in evs if e["name"] == "process_name"]
+        assert names == ["test-proc"]
+        assert doc["otherData"]["events_total"] == tr.events_total
+        assert doc["otherData"]["dropped"] == 0
+
+    def test_export_reports_dropped_after_wrap(self, tmp_path):
+        tr = Tracer(capacity=4)
+        for i in range(10):
+            tr.instant(f"e{i}")
+        path = tmp_path / "t.json"
+        tr.export(str(path))
+        doc = json.loads(path.read_text())
+        assert doc["otherData"]["dropped"] == 6
+
+    def test_clear_and_capacity_validation(self):
+        tr = Tracer(capacity=4)
+        tr.instant("a")
+        tr.clear()
+        assert tr.events() == [] and tr.events_total == 0
+        with pytest.raises(ValueError, match="capacity"):
+            Tracer(capacity=0)
+
+
+# ----------------------------------------------------------------------
+# registry
+# ----------------------------------------------------------------------
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        c = reg.counter("serving/finished")
+        c.inc()
+        c.inc(2)
+        assert c.value == 3
+        with pytest.raises(ValueError, match="negative"):
+            c.inc(-1)
+        assert reg.counter("serving/finished") is c  # idempotent
+
+        g = reg.gauge("serving/live")
+        g.set(5)
+        g.dec(2)
+        g.inc()
+        assert g.value == 4
+
+        h = reg.histogram("serving/ttft_ms")
+        for v in (0.5, 3, 30, 30, 9999):
+            h.observe(v)
+        assert h.count == 5 and h.total == pytest.approx(10062.5)
+        assert h.quantile(0.5) <= h.quantile(0.99)
+
+    def test_type_conflict_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("x")
+
+    def test_snapshot_flattens_histograms(self):
+        reg = MetricsRegistry()
+        reg.counter("a").inc(2)
+        reg.histogram("lat_ms").observe(7)
+        snap = reg.snapshot()
+        assert snap["a"] == 2
+        assert snap["lat_ms/count"] == 1
+        assert snap["lat_ms/sum"] == 7
+        assert "lat_ms/p50" in snap and "lat_ms/p99" in snap
+
+    def test_prometheus_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("serving/finished").inc(3)
+        reg.gauge("serving/live").set(2)
+        h = reg.histogram("serving/ttft_ms", buckets=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(500.0)
+        text = reg.to_prometheus()
+        assert "# TYPE serving_finished counter" in text
+        assert "serving_finished 3" in text
+        assert "# TYPE serving_live gauge" in text
+        assert "# TYPE serving_ttft_ms histogram" in text
+        # buckets are cumulative, ending at the total count
+        assert 'serving_ttft_ms_bucket{le="1"} 1' in text
+        assert 'serving_ttft_ms_bucket{le="10"} 2' in text
+        assert 'serving_ttft_ms_bucket{le="+Inf"} 3' in text
+        assert "serving_ttft_ms_sum 505.5" in text
+        assert "serving_ttft_ms_count 3" in text
+
+    def test_publish_flushes_to_monitor(self):
+        reg = MetricsRegistry()
+        reg.counter("serving/finished").inc(4)
+        reg.gauge("serving/live").set(1)
+        mon = _FakeMonitor()
+        n = reg.publish(mon, step=17)
+        assert n == 2 == len(mon.events)
+        tags = [t for t, _, _ in mon.events]
+        assert tags == sorted(tags)
+        assert all(t.startswith("telemetry/") for t in tags)
+        assert all(s == 17 for _, _, s in mon.events)
+        # disabled / missing monitors are a safe no-op
+        assert reg.publish(None, step=1) == 0
+
+        class _Off:
+            enabled = False
+
+        assert reg.publish(_Off(), step=1) == 0
+
+
+# ----------------------------------------------------------------------
+# timeline store
+# ----------------------------------------------------------------------
+class TestTimelineStore:
+    def test_record_get_and_eviction(self):
+        tl = TimelineStore(capacity=2)
+        tl.record(1, "submitted", prompt_len=4)
+        tl.record(1, "finished", terminal=True, reason="length")
+        tl.record(2, "submitted")
+        tl.record(3, "submitted")  # evicts request 1
+        assert tl.get(1) is None
+        assert tl.events_of(2) == ["submitted"]
+        assert len(tl) == 2
+        ev = tl.get(3)[0]
+        assert ev["event"] == "submitted" and ev["t_ns"] > 0
+
+    def test_mirrors_async_track_into_tracer(self):
+        tr = Tracer()
+        tl = TimelineStore(tracer=tr)
+        tl.record(7, "submitted", prompt_len=4)
+        tl.record(7, "first_token")
+        tl.record(7, "finished", terminal=True, reason="length")
+        phs = [e["ph"] for e in tr.events()]
+        assert phs[0] == "b" and phs[-1] == "e" and "n" in phs
+        assert all(e["cat"] == "request" and e["id"] == 7
+                   for e in tr.events())
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            TimelineStore(capacity=0)
+
+
+# ----------------------------------------------------------------------
+# recompile watchdog
+# ----------------------------------------------------------------------
+class _Owner:
+    def __init__(self):
+        self.fn = jax.jit(lambda x: x * 2)
+
+
+class TestWatchdog:
+    def test_attributes_cache_growth_and_warmup_split(self):
+        reg = MetricsRegistry()
+        tr = Tracer()
+        mon = _FakeMonitor()
+        owner = _Owner()
+        wd = RecompileWatchdog(registry=reg, tracer=tr, monitor=mon,
+                               step_fn=lambda: 42)
+        assert wd.attach(owner, "fn", name="fn") is not None
+        assert wd.attach(owner, "missing") is None
+
+        owner.fn(jnp.ones((4,)))          # first compile: warmup
+        assert wd.warmup_recompiles == 1 and wd.recompiles == 0
+        wd.end_warmup()
+        assert wd.warmed
+        owner.fn(jnp.ones((4,)))          # cache hit: no recompile
+        assert wd.recompiles == 0
+        owner.fn(jnp.ones((8,)))          # forced shape change
+        assert wd.recompiles == 1
+        assert reg.counter("telemetry/recompiles").value == 1
+        assert reg.counter("telemetry/recompiles_warmup").value == 1
+        ev = wd.events[-1]
+        assert ev["program"] == "fn" and not ev["warmup"]
+        assert "float32[8]" in ev["signature"]
+        assert ("telemetry/recompile", 1.0, 42) in mon.events
+        assert any(e["name"] == "telemetry/recompile" for e in tr.events())
+        assert wd.summary()["programs"] == ["fn"]
+
+    def test_attach_is_shared_across_watchdogs(self):
+        owner = _Owner()
+        wd1 = RecompileWatchdog()
+        wd2 = RecompileWatchdog()
+        p1 = wd1.attach(owner, "fn")
+        p2 = wd2.attach(owner, "fn")
+        assert p1 is p2  # never double-wrapped
+        wd1.end_warmup()
+        wd2.end_warmup()
+        owner.fn(jnp.ones((3,)))
+        assert wd1.recompiles == 1 and wd2.recompiles == 1
+        # attribute passthrough: jit internals stay reachable
+        assert owner.fn._cache_size() >= 1
+
+    def test_tolerates_plain_callables(self):
+        owner = _Owner()
+        owner.fn = lambda x: x  # tests inject bare lambdas
+        wd = RecompileWatchdog()
+        wd.attach(owner, "fn")
+        wd.end_warmup()
+        assert owner.fn(5) == 5
+        assert wd.recompiles == 0
+
+    def test_strict_mode_raises_once_per_recompile(self):
+        owner = _Owner()
+        wd = RecompileWatchdog(strict=True)
+        wd.attach(owner, "fn")
+        owner.fn(jnp.ones((4,)))
+        wd.check()                        # warmup compiles never raise
+        wd.end_warmup()
+        owner.fn(jnp.ones((16,)))
+        with pytest.raises(RecompileAfterWarmupError, match="fn"):
+            wd.check()
+        wd.check()                        # already reported: no re-raise
+        owner.fn(jnp.ones((32,)))
+        with pytest.raises(RecompileAfterWarmupError):
+            wd.check()
+
+    def test_abstract_signature(self):
+        sig = abstract_signature(
+            (np.zeros((2, 3), np.float32), 5), {"flag": True})
+        assert sig == "(float32[2,3], 5, flag=True)"
+
+
+# ----------------------------------------------------------------------
+# timers
+# ----------------------------------------------------------------------
+class TestTimers:
+    def test_barrier_timer_requires_block_on(self):
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+        timers = SynchronizedWallClockTimer()
+        t = timers("strict", barrier=True)
+        assert timers("strict") is t  # name lookup is stable
+        t.start()
+        with pytest.raises(RuntimeError, match="block_on"):
+            t.stop()
+        out = jax.jit(lambda x: x + 1)(jnp.ones((4,)))
+        t.stop(block_on=out)
+        assert len(t.records) == 1 and t.records[0] >= 0
+        # elapsed() peeks via stop(record=False): legal on barrier timers
+        t.start()
+        assert t.elapsed() >= 0
+
+    def test_plain_timer_and_publish(self):
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+        timers = SynchronizedWallClockTimer()
+        t = timers("fwd")
+        for _ in range(3):
+            t.start()
+            t.stop()
+        reg = MetricsRegistry()
+        assert timers.publish(reg) == 3
+        assert reg.histogram("timer/fwd_ms").count == 3
+        assert timers.publish(reg) == 0  # drained: no double counting
+
+    def test_throughput_timer_block_on(self):
+        from deepspeed_tpu.utils.timer import ThroughputTimer
+
+        tt = ThroughputTimer(batch_size=2, start_step=0)
+        out = jax.jit(lambda x: x * 3)(jnp.ones((4,)))
+        for _ in range(3):
+            tt.start()
+            tt.stop(global_step=True, report_speed=False, block_on=out)
+        assert tt.avg_samples_per_sec() > 0
+
+
+# ----------------------------------------------------------------------
+# JSONL monitor sink
+# ----------------------------------------------------------------------
+class TestJSONLMonitor:
+    def test_sink_writes_loadable_lines(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import JSONLMonitor
+        from deepspeed_tpu.runtime.config import JSONLConfig
+
+        cfg = JSONLConfig(enabled=True, output_path=str(tmp_path),
+                          job_name="job")
+        mon = JSONLMonitor(cfg)
+        mon.write_events([("serving/ttft_ms", 6.7, 3),
+                          ("telemetry/recompile", 1, 4)])
+        mon.write_events([("serving/ttft_ms", 7.0, 5)])
+        lines = [json.loads(ln) for ln in
+                 open(mon.path).read().splitlines()]
+        assert len(lines) == 3
+        for rec in lines:
+            assert set(rec) == {"tag", "value", "step", "time"}
+            assert isinstance(rec["value"], float)
+            assert isinstance(rec["step"], int)
+        assert lines[0]["tag"] == "serving/ttft_ms"
+        assert lines[1]["value"] == 1.0
+
+    def test_monitor_master_fans_out_to_jsonl(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+        from deepspeed_tpu.runtime.config import MonitorConfig
+
+        cfg = MonitorConfig(jsonl={"enabled": True,
+                                   "output_path": str(tmp_path),
+                                   "job_name": "j"})
+        assert cfg.enabled  # jsonl alone flips the master switch
+        master = MonitorMaster(cfg)
+        assert master.jsonl_monitor is not None
+        master.write_events([("a/b", 1.0, 0)])
+        rec = json.loads(open(master.jsonl_monitor.path).readline())
+        assert rec["tag"] == "a/b"
+
+    def test_disabled_by_default(self):
+        from deepspeed_tpu.runtime.config import MonitorConfig
+
+        cfg = MonitorConfig()
+        assert not cfg.jsonl.enabled and not cfg.enabled
+
+
+# ----------------------------------------------------------------------
+# pipeline schedule tracing
+# ----------------------------------------------------------------------
+class TestScheduleTrace:
+    def test_train_schedule_trace(self, tmp_path):
+        from deepspeed_tpu.runtime.pipe.schedule import (
+            TrainSchedule, export_schedule_trace, schedule_trace)
+
+        doc = schedule_trace(TrainSchedule, micro_batches=4, stages=2)
+        evs = doc["traceEvents"]
+        tracks = {e["args"]["name"] for e in evs
+                  if e["name"] == "thread_name"}
+        assert tracks == {"stage 0", "stage 1"}
+        names = {e["name"] for e in evs if e["ph"] == "X"}
+        assert {"ForwardPass", "BackwardPass", "OptimizerStep"} <= names
+        # every stage runs each micro-batch forward exactly once
+        for stage in (0, 1):
+            fwd = [e for e in evs if e["ph"] == "X" and e["tid"] == stage
+                   and e["name"] == "ForwardPass"]
+            assert len(fwd) == 4
+        path = tmp_path / "sched.json"
+        n = export_schedule_trace(TrainSchedule, 4, 2, str(path))
+        assert n == len(json.loads(path.read_text())["traceEvents"])

@@ -97,6 +97,32 @@ class TestCheckRegression:
         cand = _write(tmp_path, "cand.json", {"value": 1.0})
         assert _run(base, cand, "--metric", "value:sideways").returncode == 2
 
+    def test_max_recompiles_within_cap_passes(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 100.0})
+        cand = _write(tmp_path, "cand.json",
+                      {"value": 100.0,
+                       "detail": {"recompiles_after_warmup": 0}})
+        r = _run(base, cand, "--max-recompiles", "0")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "recompiles_after_warmup" in r.stdout
+
+    def test_max_recompiles_over_cap_fails(self, tmp_path):
+        # absolute gate: fails even when every relative metric improves
+        base = _write(tmp_path, "base.json", {"value": 100.0})
+        cand = _write(tmp_path, "cand.json",
+                      {"value": 200.0,
+                       "detail": {"recompiles_after_warmup": 3}})
+        r = _run(base, cand, "--max-recompiles", "2")
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout
+
+    def test_max_recompiles_missing_field_exits_2(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 1.0})
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        r = _run(base, cand, "--max-recompiles", "0")
+        assert r.returncode == 2
+        assert "recompiles_after_warmup" in r.stderr
+
 
 class TestBenchEntryPoints:
     def test_serving_stall_entry_wired(self):
@@ -105,6 +131,8 @@ class TestBenchEntryPoints:
         src = (REPO / "bench.py").read_text()
         assert "serving-stall" in src
         assert "def serving_stall_main" in src
+        assert "--trace" in src
+        assert "recompiles_after_warmup" in src
 
     def test_check_regression_importable(self):
         # the module must import without side effects (argparse only
