@@ -23,6 +23,14 @@ not exceed N. This is an absolute cap on the candidate alone — no
 baseline comparison and no threshold slack, because post-warmup
 recompiles are a zero-tolerance invariant, not a noisy measurement.
 
+``--require-zero-leaks`` gates the fault-tolerance invariants the
+``serving-chaos`` row reports: the candidate's ``detail.slot_leaks``
+must be exactly 0 and ``detail.invariants_ok`` /
+``detail.timelines_complete`` must both be true. Like
+``--max-recompiles``, these are absolute zero-tolerance checks on the
+candidate alone — a leaked slot under fault injection is a bug, not a
+regression to be thresholded.
+
 Exit codes: 0 = all metrics within threshold, 1 = at least one
 regression, 2 = unusable input (missing file, bad JSON, missing metric,
 non-numeric value). The driver treats 1 as "block the PR" and 2 as
@@ -50,7 +58,7 @@ def _load(path: str) -> Any:
         sys.exit(2)
 
 
-def _resolve(obj: Any, dotted: str, path: str) -> float:
+def _walk(obj: Any, dotted: str, path: str) -> Any:
     cur = obj
     for part in dotted.split("."):
         if not isinstance(cur, dict) or part not in cur:
@@ -58,6 +66,11 @@ def _resolve(obj: Any, dotted: str, path: str) -> float:
                   f"{path} (missing key '{part}')", file=sys.stderr)
             sys.exit(2)
         cur = cur[part]
+    return cur
+
+
+def _resolve(obj: Any, dotted: str, path: str) -> float:
+    cur = _walk(obj, dotted, path)
     if isinstance(cur, bool) or not isinstance(cur, (int, float)):
         print(f"check_regression: metric '{dotted}' in {path} is not a "
               f"number: {cur!r}", file=sys.stderr)
@@ -93,6 +106,12 @@ def main(argv=None) -> int:
                     help="absolute cap on the candidate's "
                          "detail.recompiles_after_warmup (no baseline, "
                          "no threshold slack)")
+    ap.add_argument("--require-zero-leaks", action="store_true",
+                    help="absolute gate on the candidate's fault-"
+                         "tolerance invariants (serving-chaos row): "
+                         "detail.slot_leaks == 0 and "
+                         "detail.invariants_ok / "
+                         "detail.timelines_complete true")
     args = ap.parse_args(argv)
 
     base = _load(args.baseline)
@@ -100,6 +119,22 @@ def main(argv=None) -> int:
     specs = args.metric or ["value:higher"]
 
     failed = False
+    if args.require_zero_leaks:
+        leaks = _resolve(cand, "detail.slot_leaks", args.candidate)
+        worse = leaks != 0
+        print(f"{'REGRESSION' if worse else 'ok':>10}  detail.slot_leaks "
+              f"(absolute): candidate={leaks:g} required=0")
+        failed |= worse
+        for dotted in ("detail.invariants_ok", "detail.timelines_complete"):
+            val = _walk(cand, dotted, args.candidate)
+            if not isinstance(val, bool):
+                print(f"check_regression: metric '{dotted}' in "
+                      f"{args.candidate} is not a boolean: {val!r}",
+                      file=sys.stderr)
+                sys.exit(2)
+            print(f"{'ok' if val else 'REGRESSION':>10}  {dotted} "
+                  f"(absolute): candidate={val} required=True")
+            failed |= not val
     if args.max_recompiles is not None:
         dotted = "detail.recompiles_after_warmup"
         r = _resolve(cand, dotted, args.candidate)

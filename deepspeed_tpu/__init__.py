@@ -139,13 +139,29 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
     ``{"drafter": "model", "draft_engine": small_engine}``, or a
     :class:`serving.SpecDecodeConfig`. Greedy output stays bitwise
     identical to ``spec_decode=None``; admission control tightens to
-    ``prompt + max_new_tokens <= capacity - k`` (the verify headroom)."""
+    ``prompt + max_new_tokens <= capacity - k`` (the verify headroom).
+
+    The fault-tolerance keys (all optional, all server-global):
+    ``deadline_default_ms`` (TTL applied to every submit that doesn't
+    carry its own ``deadline_ms``), ``step_wall_budget_ms`` (per-step
+    wall-time watchdog), ``guard_numerics`` (NaN/inf logits guard that
+    fails only the poisoned slot), ``degradation`` (``True``, a dict of
+    :class:`serving.resilience.DegradationConfig` overrides, or an
+    instance — the HEALTHY/PRESSURED/OVERLOADED ladder),
+    ``preempt_queue_threshold`` / ``preempt_min_run_steps`` (automatic
+    pressure preemption), and ``fault_injector`` (a
+    :class:`serving.resilience.FaultInjector` for chaos testing).
+    Per-request ``deadline_ms`` rides on ``submit()``."""
     from .serving.engine import ServingEngine
 
     serve_keys = ("policy", "do_sample", "temperature", "top_k", "top_p",
                   "seed", "monitor", "spec_decode", "prefill_chunk",
                   "prefill_token_budget", "tracer", "registry",
-                  "strict_recompile", "timeline_capacity")
+                  "strict_recompile", "timeline_capacity",
+                  "deadline_default_ms", "step_wall_budget_ms",
+                  "guard_numerics", "degradation",
+                  "preempt_queue_threshold", "preempt_min_run_steps",
+                  "fault_injector")
     serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
     engine = init_inference(model=model, config=config, **kwargs)
     return ServingEngine(engine, num_slots=num_slots,

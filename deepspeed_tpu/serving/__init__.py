@@ -5,20 +5,29 @@ whole-batch decoder into a request-level server: a FIFO admission queue
 (:mod:`.scheduler`), a fixed-shape slot pool of per-slot KV cache sized
 from the module's declared :func:`kv_cache_spec` (:mod:`.slot_pool`),
 iteration-level scheduling with per-request SLO metrics
-(:mod:`.engine`, :mod:`.metrics`), and optional draft–verify
-speculative decoding over the same fixed shapes (:mod:`.spec_decode`).
+(:mod:`.engine`, :mod:`.metrics`), optional draft–verify speculative
+decoding over the same fixed shapes (:mod:`.spec_decode`), and the
+fault-tolerance layer — deadlines, preemption, graceful degradation,
+deterministic fault injection (:mod:`.resilience`).
 Entry point: ``deepspeed_tpu.init_serving(...)`` or
 :class:`ServingEngine` directly.
 """
 
 from .engine import ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
-from .request import Request, RequestState  # noqa: F401
+from .request import (FinishReason, RejectReason, Request,  # noqa: F401
+                      RequestState)
+from .resilience import (DegradationConfig, FaultInjector,  # noqa: F401
+                         InjectedFault, InvariantViolation, LoadState,
+                         ServingStalledError)
 from .scheduler import FIFOScheduler  # noqa: F401
 from .slot_pool import SlotPool  # noqa: F401
 from .spec_decode import (  # noqa: F401
     Drafter, NGramDrafter, SmallModelDrafter, SpecDecodeConfig)
 
 __all__ = ["ServingEngine", "ServingMetrics", "Request", "RequestState",
-           "FIFOScheduler", "SlotPool", "SpecDecodeConfig", "Drafter",
-           "NGramDrafter", "SmallModelDrafter"]
+           "FinishReason", "RejectReason", "FIFOScheduler", "SlotPool",
+           "SpecDecodeConfig", "Drafter", "NGramDrafter",
+           "SmallModelDrafter", "DegradationConfig", "FaultInjector",
+           "InjectedFault", "InvariantViolation", "LoadState",
+           "ServingStalledError"]

@@ -47,6 +47,32 @@ K+1 tokens per slot per step, bitwise identical to plain greedy decode.
 Rejected draft positions are rolled back by the per-slot cache ``index``
 (:meth:`SlotPool.advance`), never by reshaping, so speculation adds
 exactly one more compiled program regardless of churn.
+
+FAULT TOLERANCE (the :mod:`.resilience` package) hardens the loop
+without ever changing a compiled shape:
+
+* per-request deadlines (``submit(..., deadline_ms=...)``) expire
+  queued requests before they cost a prefill and retire seated ones
+  through the same slot-release/index-masking rollback speculation
+  uses (``finish_reason="deadline"``);
+* ``preempt()`` evicts a seated request and re-queues it carrying its
+  generated-so-far tokens; re-admission prefills prompt + outputs
+  through the existing bucketed/chunked paths (fixed shapes, zero new
+  programs) and greedy output is bitwise identical to an un-preempted
+  run. Automatic victim selection (youngest first) kicks in when the
+  queue exceeds ``preempt_queue_threshold`` — those victims re-queue at
+  the BACK (round-robin time-slicing), or the very next grant would
+  hand each victim its own freed slot forever;
+* a HEALTHY/PRESSURED/OVERLOADED load-state machine progressively
+  shrinks the prefill token budget, suspends speculative drafting
+  (zero-length drafts through the SAME verify program — no recompile),
+  and finally sheds new submissions with ``retry_after``;
+* an optional NaN/inf logits guard (``guard_numerics``) fails ONLY the
+  poisoned slot (``finish_reason="numerical_error"``); the other slots'
+  tokens from the same dispatch are kept;
+* a seeded :class:`~deepspeed_tpu.serving.resilience.FaultInjector`
+  threads deterministic failures through five named points for the
+  chaos suite and ``bench.py serving-chaos``.
 """
 
 from __future__ import annotations
@@ -62,7 +88,10 @@ from ..telemetry import (MetricsRegistry, RecompileWatchdog, TimelineStore,
                          Tracer)
 from ..utils.logging import log_dist
 from .metrics import ServingMetrics
-from .request import Request, RequestState
+from .request import FinishReason, RejectReason, Request, RequestState
+from .resilience import (DegradationConfig, FaultInjectingDrafter,
+                         InvariantViolation, LoadState, LoadStateMachine,
+                         ServingStalledError, select_victims)
 from .scheduler import FIFOScheduler
 from .slot_pool import SlotPool
 
@@ -72,6 +101,7 @@ _WATCHED_ENGINE_JITS = ("_jit_prefill_at", "_jit_decode",
                         "_jit_prefill_chunk", "_jit_sample",
                         "_jit_verify_k", "_jit_decode_scan")
 _WATCHED_POOL_JITS = ("_admit_jit", "_admit_rows_jit")
+_WATCHED_SERVING_JITS = ("_jit_finite",)
 
 _MIN_PREFILL_BUCKET = 16
 
@@ -98,7 +128,14 @@ class ServingEngine:
                  tracer: Optional[Any] = None,
                  registry: Optional[Any] = None,
                  strict_recompile: bool = False,
-                 timeline_capacity: int = 4096):
+                 timeline_capacity: int = 4096,
+                 deadline_default_ms: Optional[float] = None,
+                 step_wall_budget_ms: Optional[float] = None,
+                 guard_numerics: bool = False,
+                 degradation: Optional[Any] = None,
+                 preempt_queue_threshold: Optional[int] = None,
+                 preempt_min_run_steps: int = 2,
+                 fault_injector: Optional[Any] = None):
         self.engine = engine
         # materialize params + jits before sizing anything off the module
         engine._ensure_params(jnp.zeros((1, 2), jnp.int32))
@@ -156,6 +193,37 @@ class ServingEngine:
             strict=strict_recompile, step_fn=lambda: self.step_id)
         self.metrics = ServingMetrics(monitor, registry=self.registry,
                                       step_fn=lambda: self.step_id)
+        # -- resilience ------------------------------------------------
+        if deadline_default_ms is not None and deadline_default_ms <= 0:
+            raise ValueError(f"deadline_default_ms must be > 0, got "
+                             f"{deadline_default_ms}")
+        if step_wall_budget_ms is not None and step_wall_budget_ms <= 0:
+            raise ValueError(f"step_wall_budget_ms must be > 0, got "
+                             f"{step_wall_budget_ms}")
+        if preempt_queue_threshold is not None and preempt_queue_threshold < 1:
+            raise ValueError(f"preempt_queue_threshold must be >= 1, got "
+                             f"{preempt_queue_threshold}")
+        self.deadline_default_ms = deadline_default_ms
+        self.step_wall_budget_ms = step_wall_budget_ms
+        self.preempt_queue_threshold = preempt_queue_threshold
+        self.preempt_min_run_steps = int(preempt_min_run_steps)
+        self._degradation = DegradationConfig.from_value(degradation)
+        self._load = (LoadStateMachine(self._degradation)
+                      if self._degradation is not None else None)
+        self.faults = fault_injector
+        if self.faults is not None and self._drafter is not None:
+            # surface drafter faults exactly where a real drafter throws
+            self._drafter = FaultInjectingDrafter(self._drafter, self.faults)
+        # one tiny always-fixed-shape program: (num_slots,) bool of "is
+        # every logit in this row finite". Guarding decode logits (not
+        # every intermediate) catches poisoned rows before their token
+        # is committed, at one watched jit and zero recompiles.
+        if guard_numerics:
+            self._jit_finite = jax.jit(
+                lambda l: jnp.all(jnp.isfinite(l),
+                                  axis=tuple(range(1, l.ndim))))
+        else:
+            self._jit_finite = None
         # -- stall-free admission config -------------------------------
         if prefill_chunk < 0:
             raise ValueError(f"prefill_chunk must be >= 0, got "
@@ -214,6 +282,8 @@ class ServingEngine:
             wd.attach(self.engine, attr, name=f"InferenceEngine.{attr}")
         for attr in _WATCHED_POOL_JITS:
             wd.attach(self.pool, attr, name=f"SlotPool.{attr}")
+        for attr in _WATCHED_SERVING_JITS:
+            wd.attach(self, attr, name=f"ServingEngine.{attr}")
 
     def end_warmup(self) -> None:
         """Declare warmup traffic over: from here on, any recompile counts
@@ -247,11 +317,20 @@ class ServingEngine:
         return self.scheduler.pending
 
     def submit(self, prompt, max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None) -> Request:
+               eos_token_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> Request:
         """Enqueue one generation request. Never raises on load: admission
         control marks the returned request ``REJECTED`` with a
-        ``reject_reason`` (``"queue_full"``, ``"prompt_too_long"``) so
-        callers can shed or retry."""
+        ``reject_reason`` (``"queue_full"``, ``"prompt_too_long"``, or
+        ``"retry_after"`` when overload shedding is active — then
+        ``req.retry_after_s`` carries the backoff hint) so callers can
+        shed or retry.
+
+        ``deadline_ms`` (or the engine-wide ``deadline_default_ms``)
+        arms a TTL from submission: a request that can't finish in time
+        retires with ``finish_reason="deadline"`` — out of the queue
+        before ever costing a prefill, or out of its slot via the usual
+        release/masking rollback."""
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens}")
@@ -259,7 +338,21 @@ class ServingEngine:
         req = Request(self._next_id, prompt, max_new_tokens, eos_token_id)
         self._next_id += 1
         req.submit_time = self._now()
-        accepted, reason = self.scheduler.submit(req)
+        ttl = deadline_ms if deadline_ms is not None \
+            else self.deadline_default_ms
+        if ttl is not None:
+            if ttl <= 0:
+                raise ValueError(f"deadline_ms must be > 0, got {ttl}")
+            req.deadline_ms = float(ttl)
+            req.deadline_time = req.submit_time + float(ttl) / 1e3
+        if self._load is not None and self._load.state is LoadState.OVERLOADED:
+            # overload shedding: stop feeding the queue before it melts;
+            # rejected-with-retry_after is cheaper for everyone than an
+            # accepted request that will blow its deadline anyway
+            accepted, reason = False, RejectReason.RETRY_AFTER
+            req.retry_after_s = self._degradation.retry_after_s
+        else:
+            accepted, reason = self.scheduler.submit(req)
         self.timelines.record(req.request_id, "submitted",
                               prompt_len=req.prompt_len,
                               max_new_tokens=max_new_tokens)
@@ -268,7 +361,8 @@ class ServingEngine:
             req.reject_reason = reason
             self.metrics.record_rejection(req)
             self.timelines.record(req.request_id, "rejected", terminal=True,
-                                  reason=reason)
+                                  reason=reason.value,
+                                  retry_after_s=req.retry_after_s)
         return req
 
     # ------------------------------------------------------------------
@@ -288,11 +382,19 @@ class ServingEngine:
     def _admit(self, req: Request, finished: List[Request]) -> None:
         eng = self.engine
         slot = self.pool.alloc()
+        # rollback snapshot: a PREEMPTED request arrives carrying its
+        # generated-so-far tokens and first-token stamp — a failed
+        # re-admission must restore exactly that state, never wipe it
+        n0 = len(req.output_tokens)
+        admit0, first0 = req.admit_time, req.first_token_time
         try:
-            T = req.prompt_len
+            if self.faults is not None:
+                self.faults.check("admit_oom")
+            seed = req.seed_tokens        # prompt, + outputs when resumed
+            T = req.seed_len
             width = self._bucket(T, self.pool.capacity)
             ids = np.zeros((1, width), np.int32)
-            ids[0, :T] = req.prompt
+            ids[0, :T] = seed
             running_before = self._running_count()
             req.admit_time = self._now()
             with self.tracer.span("serving/admit", rid=req.request_id,
@@ -304,30 +406,34 @@ class ServingEngine:
                 with self.tracer.span("serving/sample"):
                     # device sync: token exists
                     token = int(self._sample(logits)[0])
-            req.first_token_time = self._now()
-            self.metrics.record_prefill(T, req.first_token_time -
-                                        req.admit_time,
+            now = self._now()
+            if req.first_token_time is None:
+                req.first_token_time = now
+            self.metrics.record_prefill(T, now - req.admit_time,
                                         blocking=running_before > 0)
             req.slot = slot
             self._slot_req[slot] = req
             req.state = RequestState.RUNNING
+            req.last_admit_step = self.step_id
             req.output_tokens.append(token)
             self._current[slot] = token
             self.timelines.record(req.request_id, "admitted", slot=slot,
                                   mode="bucketed")
-            self.timelines.record(req.request_id, "first_token")
+            if n0 == 0:
+                self.timelines.record(req.request_id, "first_token")
             self.tracer.flow("s", "req", req.request_id)
         except Exception:
             # undo the partial admission so the request can be re-queued
-            # with no trace: the slot goes back, timing/output state is
-            # reset, and _abort_step sees a clean QUEUED request
+            # with no trace: the slot goes back and timing/output state
+            # reverts to the pre-admission snapshot, so _abort_step sees
+            # a clean QUEUED request (resumed ones keep their tokens)
             self._slot_req.pop(slot, None)
             self.pool.release(slot)
             req.state = RequestState.QUEUED
             req.slot = None
-            req.admit_time = None
-            req.first_token_time = None
-            del req.output_tokens[:]
+            req.admit_time = admit0
+            req.first_token_time = first0
+            del req.output_tokens[n0:]
             raise
         self._maybe_retire(req, token, finished)
 
@@ -337,9 +443,11 @@ class ServingEngine:
 
     def _admission_cost(self, req: Request) -> int:
         """Prefill tokens this grant charges against the step budget: the
-        padded bucket width for a whole-prompt admission, one chunk for a
-        long prompt (only its first chunk can run this step)."""
-        T = req.prompt_len
+        padded bucket width for a whole-seed admission, one chunk for a
+        long seed (only its first chunk can run this step). Preempted
+        requests are charged for prompt + generated-so-far — that is
+        what re-admission actually prefills."""
+        T = req.seed_len
         if T <= self.prefill_chunk:
             return self._bucket(T, self.pool.capacity)
         return self.prefill_chunk
@@ -352,7 +460,7 @@ class ServingEngine:
         prefilled + scattered in ONE batched dispatch."""
         groups: dict = {}
         for req in granted:
-            T = req.prompt_len
+            T = req.seed_len
             if T > self.prefill_chunk:
                 slot = self.pool.alloc()
                 self.pool.reset_row(slot)
@@ -360,6 +468,7 @@ class ServingEngine:
                 req.slot = slot
                 req.prefill_pos = 0
                 req.state = RequestState.PREFILLING
+                req.last_admit_step = self.step_id
                 self._slot_req[slot] = req
                 self._prefill_queue.append(req)
                 self.timelines.record(req.request_id, "admitted", slot=slot,
@@ -396,10 +505,16 @@ class ServingEngine:
         slots = np.full((nB,), self.pool.num_slots, np.int32)
         lengths = np.zeros((nB,), np.int32)
         running_before = self._running_count()
+        # rollback snapshots (preempted group members keep their tokens
+        # and stamps if this dispatch dies — see _admit)
+        n0s = [len(r.output_tokens) for r in group]
+        stamps = [(r.admit_time, r.first_token_time) for r in group]
         try:
+            if self.faults is not None:
+                self.faults.check("admit_oom")
             for i, req in enumerate(group):
-                T = req.prompt_len
-                ids[i, :T] = req.prompt
+                T = req.seed_len
+                ids[i, :T] = req.seed_tokens
                 last_pos[i] = T - 1
                 slots[i] = self.pool.alloc()
                 lengths[i] = T
@@ -418,20 +533,24 @@ class ServingEngine:
             for i, req in enumerate(group):
                 token = int(tokens[i])
                 slot = int(slots[i])
-                req.first_token_time = now
+                if req.first_token_time is None:
+                    req.first_token_time = now
                 req.slot = slot
                 self._slot_req[slot] = req
                 req.state = RequestState.RUNNING
+                req.last_admit_step = self.step_id
                 req.output_tokens.append(token)
                 self._current[slot] = token
                 self.timelines.record(req.request_id, "admitted", slot=slot,
                                       mode="batched")
-                self.timelines.record(req.request_id, "first_token")
+                if n0s[i] == 0:
+                    self.timelines.record(req.request_id, "first_token")
                 self.tracer.flow("s", "req", req.request_id)
                 self._maybe_retire(req, token, finished)
         except Exception:
             # roll the whole group back to clean QUEUED requests so
-            # _abort_step re-queues them with no trace
+            # _abort_step re-queues them with no trace (resumed members
+            # revert to their pre-admission snapshots)
             for i, req in enumerate(group):
                 slot = int(slots[i])
                 if slot < self.pool.num_slots:
@@ -439,9 +558,8 @@ class ServingEngine:
                     self.pool.release(slot)
                 req.state = RequestState.QUEUED
                 req.slot = None
-                req.admit_time = None
-                req.first_token_time = None
-                del req.output_tokens[:]
+                req.admit_time, req.first_token_time = stamps[i]
+                del req.output_tokens[n0s[i]:]
             raise
 
     def _prefill_chunk_step(self, finished: List[Request]) -> None:
@@ -456,9 +574,11 @@ class ServingEngine:
         slot = req.slot
         C = self.prefill_chunk
         pos = req.prefill_pos
-        L = min(C, req.prompt_len - pos)
+        seed = req.seed_tokens            # prompt, + outputs when resumed
+        seed_len = req.seed_len
+        L = min(C, seed_len - pos)
         ids = np.zeros((1, C), np.int32)
-        ids[0, :L] = np.asarray(req.prompt, np.int32)[pos:pos + L]
+        ids[0, :L] = seed[pos:pos + L]
         running_before = self._running_count()
         t0 = self._now()
         with self.tracer.span("serving/prefill_chunk", rid=req.request_id,
@@ -471,18 +591,22 @@ class ServingEngine:
         req.chunks += 1
         self.timelines.record(req.request_id, "prefill_chunk", pos=pos,
                               len=L)
-        if req.prefill_pos >= req.prompt_len:
+        if req.prefill_pos >= seed_len:
             with self.tracer.span("serving/sample"):
                 token = int(self._sample(logits)[0])  # device sync
             now = self._now()
             self.metrics.record_prefill(L, now - t0,
                                         blocking=running_before > 0)
             self._prefill_queue.pop(0)
-            req.first_token_time = now
+            first = req.first_token_time is None
+            if first:
+                req.first_token_time = now
             req.state = RequestState.RUNNING
+            req.last_admit_step = self.step_id
             req.output_tokens.append(token)
             self._current[slot] = token
-            self.timelines.record(req.request_id, "first_token")
+            if first:
+                self.timelines.record(req.request_id, "first_token")
             self._maybe_retire(req, token, finished)
         else:
             # no sync: the chunk is enqueued and this step's decode
@@ -495,29 +619,123 @@ class ServingEngine:
     def _maybe_retire(self, req: Request, token: int,
                       finished: List[Request]) -> None:
         if req.eos_token_id is not None and token == req.eos_token_id:
-            req.finish_reason = "eos"
+            req.finish_reason = FinishReason.EOS
         elif len(req.output_tokens) >= req.max_new_tokens:
-            req.finish_reason = "length"
+            req.finish_reason = FinishReason.LENGTH
         elif req.slot is not None and \
                 int(self.pool.starts[req.slot]) >= self.pool.capacity:
             # the slot's cache row is full: retire rather than silently
             # clamp-overwrite the last column on the next decode write
-            req.finish_reason = "length_cap"
+            req.finish_reason = FinishReason.LENGTH_CAP
         else:
             return
         req.state = RequestState.FINISHED
         req.finish_time = self._now()
         self.pool.release(req.slot)
         del self._slot_req[req.slot]
+        self._finish_record(req)
+        finished.append(req)
+
+    def _finish_record(self, req: Request) -> None:
+        """Shared terminal bookkeeping for every FINISHED retirement
+        (normal, length-capped, or deadline-expired): metrics, the flow
+        arrow, and the terminal timeline event."""
         self.metrics.record_finish(req)
         self.tracer.flow("f", "req", req.request_id)
         self.timelines.record(req.request_id, "finished", terminal=True,
-                              reason=req.finish_reason,
+                              reason=FinishReason.of(req.finish_reason).value,
                               new_tokens=len(req.output_tokens),
                               chunks=req.chunks,
                               spec_drafted=req.spec_drafted,
                               spec_accepted=req.spec_accepted)
-        finished.append(req)
+
+    # -- resilience: eviction, deadlines, preemption -------------------
+    def _evict_slot(self, req: Request) -> None:
+        """Reclaim a seated request's slot through the rollback path:
+        release the slot (its stale KV becomes masked padding, exactly
+        like a rejected draft tail) and detach all seat state. The
+        caller decides what the request becomes next (FINISHED on
+        deadline, QUEUED on preemption, FAILED on poisoned logits)."""
+        slot = req.slot
+        del self._slot_req[slot]
+        self.pool.release(slot)
+        req.slot = None
+        # identity filter, not remove(): value equality on requests would
+        # elementwise-compare their numpy prompts
+        self._prefill_queue[:] = [r for r in self._prefill_queue
+                                  if r is not req]
+
+    def _expire_deadlines(self, finished: List[Request]) -> None:
+        """Retire every request whose deadline has passed: queued ones
+        before they cost a prefill, seated ones via slot eviction. Runs
+        at the step boundary so a mid-step expiry can never interleave
+        with a dispatch."""
+        now = self._now()
+        expired = self.scheduler.expire(now)
+        for slot, req in list(self._slot_req.items()):
+            if req.expired(now):
+                self._evict_slot(req)
+                expired.append(req)
+        for req in expired:
+            req.state = RequestState.FINISHED
+            req.finish_reason = FinishReason.DEADLINE
+            req.finish_time = now
+            self._finish_record(req)
+            finished.append(req)
+
+    def preempt(self, request_id: int) -> Request:
+        """Evict a seated (RUNNING or PREFILLING) request and re-queue it
+        at the FRONT of the admission queue carrying its generated-so-far
+        tokens. Re-admission prefills prompt + outputs through the
+        existing bucketed/chunked paths — fixed shapes, zero new
+        programs — and greedy output is bitwise identical to never having
+        been preempted (see ``Request.seed_tokens``). Raises
+        ``ValueError`` if the id is not currently seated."""
+        for req in self._slot_req.values():
+            if req.request_id == request_id:
+                self._preempt_req(req, auto=False)
+                return req
+        raise ValueError(f"request {request_id} is not seated in a slot "
+                         f"(only RUNNING/PREFILLING requests can be "
+                         f"preempted)")
+
+    def _preempt_req(self, req: Request, auto: bool) -> None:
+        slot = req.slot
+        self._evict_slot(req)
+        req.state = RequestState.QUEUED
+        req.prefill_pos = 0       # a partial chunked prefill restarts
+        req.admit_time = None
+        req.preemptions += 1
+        if auto:
+            # pressure victims go to the BACK: re-queueing at the head
+            # would hand the victim its own freed slot at the very next
+            # grant — an infinite preempt/re-admit swap that generates
+            # nothing. Tail requeue yields round-robin time-slicing with
+            # the arrivals that caused the pressure.
+            self.scheduler.requeue_back([req])
+        else:
+            self.scheduler.requeue_front([req])
+        self.metrics.record_preemption(req)
+        self.timelines.record(req.request_id, "preempted", slot=slot,
+                              auto=auto, generated=len(req.output_tokens))
+        self.tracer.instant("serving/preempt", rid=req.request_id,
+                            slot=slot, auto=auto)
+
+    def _auto_preempt(self) -> None:
+        """Pressure valve: when the queue has outgrown the threshold and
+        every slot is taken, evict ONE victim per step (youngest /
+        least-progress first; must have held its slot for
+        ``preempt_min_run_steps``). One per step is deliberate — paced
+        eviction keeps the batch mostly busy while pressure drains."""
+        if (self.preempt_queue_threshold is None
+                or self.scheduler.pending <= self.preempt_queue_threshold
+                or self.pool.free_count > 0):
+            return
+        victims = select_victims(
+            list(self._slot_req.values()), n=1, current_step=self.step_id,
+            min_run_steps=self.preempt_min_run_steps)
+        for req in victims:
+            self._preempt_req(req, auto=True)
 
     # ------------------------------------------------------------------
     def step(self) -> List[Request]:
@@ -536,6 +754,12 @@ class ServingEngine:
         t_step = self._now()
         running_at_entry = self._running_count()
         with tracer.span("serving/step", step=self.step_id):
+            # boundary work first, outside the abort scope: expiring a
+            # deadline or walking the load ladder touches no device
+            # state, so a failure here must not FAIL innocent requests
+            self._expire_deadlines(finished)
+            self._update_load_state()
+            self._auto_preempt()
             tracer.counter("serving/occupancy", live=self.live_count,
                            pending=self.scheduler.pending)
             with tracer.span("serving/grant"):
@@ -546,7 +770,7 @@ class ServingEngine:
                     spent = self.prefill_chunk if self._prefill_queue else 0
                     granted = self.scheduler.grant(
                         self.pool.free_count, self.live_count,
-                        token_budget=self.prefill_token_budget,
+                        token_budget=self._effective_prefill_budget(),
                         cost=self._admission_cost, spent=spent)
                 else:
                     granted = self.scheduler.grant(self.pool.free_count,
@@ -558,6 +782,13 @@ class ServingEngine:
                 else:
                     for req in granted:
                         self._admit(req, finished)
+                if self.faults is not None:
+                    # the host-exception and slow-dispatch points sit
+                    # between admission and decode: requests are seated
+                    # (worst case for the abort path) but no decode
+                    # state has moved yet
+                    self.faults.maybe_sleep("slow_dispatch")
+                    self.faults.check("step_host_error")
                 if self._running_count():
                     t0 = self._now()
                     if self._spec is not None:
@@ -571,12 +802,83 @@ class ServingEngine:
         # mid-step would trigger _abort_step and FAIL innocent in-flight
         # requests, when the state is actually perfectly consistent
         self.watchdog.check()
+        wall = self._now() - t_step
+        if self.step_wall_budget_ms is not None and \
+                wall * 1e3 > self.step_wall_budget_ms:
+            # per-step wall-time watchdog: flag, don't kill — one slow
+            # step is an observability event; sustained slowness shows
+            # up in step_gap p99 and drives the load-state machine
+            self.metrics.record_step_overrun(wall, self.step_wall_budget_ms)
+            tracer.instant("serving/step_overrun", wall_ms=wall * 1e3,
+                           budget_ms=self.step_wall_budget_ms)
         if running_at_entry:
             # a running request waited through this WHOLE step for its
             # next token — the user-visible inter-token gap, admission
             # work included (what stall-free admission bounds)
-            self.metrics.record_step_gap(self._now() - t_step)
+            self.metrics.record_step_gap(wall)
         return finished
+
+    def _effective_prefill_budget(self) -> Optional[int]:
+        """The step's prefill token budget after degradation: PRESSURED
+        halves it (floor: one chunk), OVERLOADED pins it at one chunk —
+        admission slows before live decode latency does."""
+        budget = self.prefill_token_budget
+        if budget is None or self._load is None:
+            return budget
+        if self._load.state is LoadState.OVERLOADED:
+            return max(self.prefill_chunk, 1)
+        if self._load.state is LoadState.PRESSURED:
+            return max(self.prefill_chunk, budget // 2)
+        return budget
+
+    def _update_load_state(self) -> None:
+        if self._load is None:
+            return
+        cfg = self._degradation
+        gaps = self.metrics.step_gaps[-cfg.window:]
+        p99 = float(np.percentile(np.asarray(gaps), 99) * 1e3) \
+            if gaps else None
+        moved = self._load.update(self.scheduler.pending, p99,
+                                  step=self.step_id)
+        self.tracer.counter("serving/load_state",
+                            level=int(self._load.state))
+        if moved is not None:
+            old, new = moved
+            self.metrics.record_load_state(old, new)
+            self.tracer.instant("serving/load_transition", old=old.name,
+                                new=new.name, queue=self.scheduler.pending,
+                                gap_p99_ms=p99)
+            log_dist(f"ServingEngine: load {old.name} -> {new.name} "
+                     f"(queue={self.scheduler.pending}, "
+                     f"gap_p99_ms={p99})", ranks=[0])
+
+    def _fail_slot(self, req: Request, reason: FinishReason) -> None:
+        """Fail ONE seated request (poisoned logits): evict its slot via
+        the rollback path and mark it FAILED, leaving every other slot's
+        tokens from the same dispatch untouched."""
+        self._evict_slot(req)
+        req.state = RequestState.FAILED
+        req.finish_reason = reason
+        req.finish_time = self._now()
+        self.metrics.record_failure(req)
+        self.tracer.flow("f", "req", req.request_id)
+        self.timelines.record(req.request_id, "failed", terminal=True,
+                              reason=reason.value,
+                              new_tokens=len(req.output_tokens))
+
+    def _guard_logits(self, logits, running):
+        """NaN/inf guard on the decode logits: returns the survivors of
+        ``running``, failing only rows whose logits are non-finite. One
+        fixed-shape watched jit + one tiny host sync, only when
+        ``guard_numerics`` is on."""
+        if self._jit_finite is None or not running:
+            return running
+        finite = np.asarray(self._jit_finite(logits))
+        ok = [(slot, req) for slot, req in running if bool(finite[slot])]
+        for slot, req in running:
+            if not bool(finite[slot]):
+                self._fail_slot(req, FinishReason.NUMERICAL_ERROR)
+        return ok
 
     def _decode_step(self, finished: List[Request], t0: float) -> None:
         eng = self.engine
@@ -587,6 +889,10 @@ class ServingEngine:
         with self.tracer.span("serving/decode", live=len(running)):
             logits, cache = eng._jit_decode(eng.params, self.pool.cache,
                                             tokens, pos)
+        if self.faults is not None:
+            logits, _ = self.faults.corrupt_logits(
+                logits, [slot for slot, _ in running])
+        running = self._guard_logits(logits, running)
         self.pool.cache = cache
         if self._prefill_queue:
             # PREFILLING slots rode along as masked padding: the decode
@@ -623,15 +929,25 @@ class ServingEngine:
         # proposes nothing for them (draft_len 0) and their deltas stay
         # 0 below, so verify's masked garbage writes are rolled back by
         # the index overwrite and later overwritten by their next chunk
-        histories: List[Optional[np.ndarray]] = [None] * B
-        for slot, req in self._slot_req.items():
-            if req.state is RequestState.RUNNING:
-                histories[slot] = req.tokens()
-        with self.tracer.span("serving/draft", k=K):
-            draft, draft_len = self._drafter.propose(histories, K)
-        draft = np.asarray(draft, np.int32)
-        draft_len = np.clip(np.asarray(draft_len, np.int32), 0, K)
-        t_draft = self._now() - t0
+        if self._load is not None and \
+                self._load.state is LoadState.OVERLOADED:
+            # degradation: suspend speculation WITHOUT changing a shape —
+            # zero-length drafts flow through the same verify_k program
+            # (draft_len 0 reduces it to plain decode per row), so the
+            # suspension and the recovery are both recompile-free
+            draft = np.zeros((B, K), np.int32)
+            draft_len = np.zeros((B,), np.int32)
+            t_draft = 0.0
+        else:
+            histories: List[Optional[np.ndarray]] = [None] * B
+            for slot, req in self._slot_req.items():
+                if req.state is RequestState.RUNNING:
+                    histories[slot] = req.tokens()
+            with self.tracer.span("serving/draft", k=K):
+                draft, draft_len = self._drafter.propose(histories, K)
+            draft = np.asarray(draft, np.int32)
+            draft_len = np.clip(np.asarray(draft_len, np.int32), 0, K)
+            t_draft = self._now() - t0
 
         tokens = np.concatenate([self._current[:, None], draft], axis=1)
         self._rng, sub = jax.random.split(self._rng)
@@ -697,36 +1013,129 @@ class ServingEngine:
             req.slot = None
             req.admit_time = None
             req.prefill_pos = 0
-            del req.output_tokens[:]
+            # output_tokens are NOT cleared: a preempted request mid-
+            # re-prefill owns real generated tokens — they are its seed,
+            # rebuilt from scratch on the next admission
             self.timelines.record(req.request_id, "requeued",
                                   reason="step_error")
         self.scheduler.requeue_front(prefilling)
         self._prefill_queue[:] = []
         for req in self._slot_req.values():
             req.state = RequestState.FAILED
-            req.finish_reason = "error"
+            req.finish_reason = FinishReason.ERROR
             req.finish_time = self._now()
             self.metrics.record_failure(req)
             self.timelines.record(req.request_id, "failed", terminal=True,
-                                  reason="error")
+                                  reason=FinishReason.ERROR.value)
         self._slot_req.clear()
         self._current[:] = 0
         self.pool.reset()
 
-    def run_until_drained(self, max_steps: Optional[int] = None
-                          ) -> List[Request]:
+    def run_until_drained(self, max_steps: Optional[int] = None,
+                          stall_patience: int = 32) -> List[Request]:
         """Step until the queue and every slot are empty (or ``max_steps``).
-        Every step with live work either emits a token or advances a
-        prefill by a full chunk, and every prompt and budget is finite,
-        so this terminates."""
+        Every healthy step with live work either emits a token, advances
+        a prefill by a full chunk, or changes the queue/slot population,
+        and every prompt and budget is finite — so a progress signature
+        that sits IDENTICAL for ``stall_patience`` consecutive steps can
+        only mean a livelock (scheduler bug, budget deadlock, preemption
+        thrash). Rather than hang forever, that raises
+        :class:`~deepspeed_tpu.serving.resilience.ServingStalledError`
+        carrying a dump of every stuck request's state."""
         out: List[Request] = []
         steps = 0
+        last_sig = None
+        still = 0
         while self.scheduler.pending or self._slot_req:
             out.extend(self.step())
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+            sig = self._progress_signature()
+            if sig == last_sig:
+                still += 1
+                if still >= stall_patience:
+                    dump = self._stuck_dump()
+                    raise ServingStalledError(
+                        f"no progress for {still} consecutive steps "
+                        f"(step_id={self.step_id}, pending="
+                        f"{self.scheduler.pending}, live="
+                        f"{self.live_count}); stuck requests: {dump}",
+                        dump=dump)
+            else:
+                still = 0
+                last_sig = sig
         return out
+
+    def _progress_signature(self) -> tuple:
+        """Everything that must move for the drain loop to be making
+        progress: queue/slot population, finished/failed totals, tokens
+        generated and prefill positions of every seated request."""
+        return (self.scheduler.pending, len(self._slot_req),
+                len(self.metrics.finished), self.metrics.failed,
+                tuple(sorted(
+                    (r.request_id, r.state.value, len(r.output_tokens),
+                     r.prefill_pos)
+                    for r in self._slot_req.values())))
+
+    def _stuck_dump(self) -> List[dict]:
+        """Host-side state of every non-terminal request, for the
+        ServingStalledError payload."""
+        reqs = list(self._slot_req.values()) + list(self.scheduler.queue)
+        return [{"request_id": r.request_id, "state": r.state.value,
+                 "slot": r.slot, "prefill_pos": r.prefill_pos,
+                 "seed_len": r.seed_len,
+                 "new_tokens": len(r.output_tokens),
+                 "max_new_tokens": r.max_new_tokens,
+                 "preemptions": r.preemptions,
+                 "deadline_ms": r.deadline_ms} for r in reqs]
+
+    def check_invariants(self) -> None:
+        """Audit the engine/pool/scheduler cross-bookkeeping; raises
+        :class:`~deepspeed_tpu.serving.resilience.InvariantViolation`
+        listing every violation (never just the first) if any state is
+        inconsistent. The chaos suite calls this after every injected
+        fault — the contract is that NO fault, wherever injected, may
+        leak a slot or strand a request."""
+        errors = list(self.pool.consistency_errors())
+        seated = set(self._slot_req.keys())
+        free = set(self.pool._free_set)
+        overlap = seated & free
+        if overlap:
+            errors.append(f"slots both seated and free: {sorted(overlap)}")
+        missing = set(range(self.pool.num_slots)) - seated - free
+        if missing:
+            errors.append(f"slots leaked (neither seated nor free): "
+                          f"{sorted(missing)}")
+        for slot, req in self._slot_req.items():
+            if req.slot != slot:
+                errors.append(f"slot map disagrees: _slot_req[{slot}] has "
+                              f"req {req.request_id} with req.slot="
+                              f"{req.slot}")
+            if req.state not in (RequestState.RUNNING,
+                                 RequestState.PREFILLING):
+                errors.append(f"seated req {req.request_id} in state "
+                              f"{req.state.value}")
+        prefilling_ids = sorted(
+            r.request_id for r in self._slot_req.values()
+            if r.state is RequestState.PREFILLING)
+        queue_ids = sorted(r.request_id for r in self._prefill_queue)
+        if prefilling_ids != queue_ids:
+            errors.append(f"PREFILLING seated requests {prefilling_ids} != "
+                          f"prefill queue {queue_ids}")
+        for r in self.scheduler.queue:
+            if r.state is not RequestState.QUEUED:
+                errors.append(f"queued req {r.request_id} in state "
+                              f"{r.state.value}")
+            if r.slot is not None:
+                errors.append(f"queued req {r.request_id} still holds "
+                              f"slot {r.slot}")
+        if np.any(self.pool.starts < 0) or \
+                np.any(self.pool.starts > self.pool.capacity):
+            errors.append(f"cache starts out of [0, {self.pool.capacity}]: "
+                          f"{self.pool.starts.tolist()}")
+        if errors:
+            raise InvariantViolation(errors)
 
     def stats(self) -> dict:
         """Aggregate SLO snapshot (see ServingMetrics.snapshot)."""

@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .request import Request
+from .request import FinishReason, RejectReason, Request
 
 
 def _pct(values: List[float], q: float) -> Optional[float]:
@@ -47,6 +47,10 @@ class ServingMetrics:
         self.finished: List[Request] = []
         self.rejected: Dict[str, int] = {}
         self.failed: int = 0
+        self.failed_reasons: Dict[str, int] = {}
+        self.preempted: int = 0
+        self.step_overruns: int = 0
+        self.load_transitions: int = 0
         # decode-step aggregates (speculative decoding efficiency):
         # slot_steps counts (live slot, step) pairs so tokens/decode-step
         # is per-slot — plain decode pins it at exactly 1.0 and any
@@ -88,7 +92,9 @@ class ServingMetrics:
             self.registry.histogram(name).observe(seconds * 1e3)
 
     def record_rejection(self, req: Request) -> None:
-        reason = req.reject_reason or "unknown"
+        # validate against the closed enum BEFORE emitting: a typo'd
+        # reason must fail here, not silently fork a new metrics series
+        reason = RejectReason.of(req.reject_reason).value
         self.rejected[reason] = self.rejected.get(reason, 0) + 1
         self._inc(f"serving/rejected/{reason}")
         if self.monitor is not None and getattr(self.monitor, "enabled", True):
@@ -96,12 +102,48 @@ class ServingMetrics:
                 (f"serving/rejected/{reason}", 1.0, self._step())])
 
     def record_failure(self, req: Request) -> None:
-        """A running request killed by a mid-step engine exception."""
+        """A running request killed mid-flight: a step-wide engine
+        exception (``error``) or a per-slot NaN/inf logits detection
+        (``numerical_error``)."""
+        reason = FinishReason.of(req.finish_reason or FinishReason.ERROR).value
         self.failed += 1
+        self.failed_reasons[reason] = self.failed_reasons.get(reason, 0) + 1
         self._inc("serving/failed")
+        self._inc(f"serving/failed/{reason}")
+        if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            step = self._step()
+            self.monitor.write_events([
+                ("serving/failed", 1.0, step),
+                (f"serving/failed/{reason}", 1.0, step)])
+
+    def record_preemption(self, req: Request) -> None:
+        """A seated request evicted back to the queue (slot reclaimed;
+        its generated tokens ride along and are re-prefilled on
+        resume)."""
+        self.preempted += 1
+        self._inc("serving/preempted")
         if self.monitor is not None and getattr(self.monitor, "enabled", True):
             self.monitor.write_events([
-                ("serving/failed", 1.0, self._step())])
+                ("serving/preempted", 1.0, self._step())])
+
+    def record_step_overrun(self, seconds: float, budget_ms: float) -> None:
+        """One scheduler step blew through the per-step wall-time budget
+        (the step watchdog fired)."""
+        self.step_overruns += 1
+        self._inc("serving/step_overruns")
+        self._observe_ms("serving/step_overrun_ms", seconds)
+        if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            self.monitor.write_events([
+                ("serving/step_overrun_ms", seconds * 1e3, self._step())])
+
+    def record_load_state(self, old: Any, new: Any) -> None:
+        """A graceful-degradation transition; the event value is the NEW
+        level's int encoding so dashboards plot the ladder directly."""
+        self.load_transitions += 1
+        self._inc("serving/load_transitions")
+        if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            self.monitor.write_events([
+                ("serving/load_state", float(int(new)), self._step())])
 
     def record_decode_step(self, emitted: int, live_slots: int,
                            drafted: int = 0, accepted: int = 0,
@@ -145,6 +187,7 @@ class ServingMetrics:
             self.stall_time += seconds
 
     def record_finish(self, req: Request) -> None:
+        reason = FinishReason.of(req.finish_reason).value  # closed enum
         self.finished.append(req)
         self._inc("serving/finished")
         if req.ttft is not None:
@@ -155,11 +198,12 @@ class ServingMetrics:
             self._observe_ms("serving/per_token_ms", req.per_token_latency)
         if self.monitor is not None and getattr(self.monitor, "enabled", True):
             step = self._step()
-            if req.finish_reason == "length_cap":
-                # a slot hit the allocated max_seq_len mid-generation —
-                # ops-worthy (capacity sizing), so it gets its own event
+            if reason not in (FinishReason.EOS, FinishReason.LENGTH):
+                # the abnormal retirements (length_cap: capacity sizing;
+                # deadline: SLO misses) are ops-worthy — each gets its
+                # own per-reason event series
                 self.monitor.write_events([
-                    ("serving/finished/length_cap", 1.0, step)])
+                    (f"serving/finished/{reason}", 1.0, step)])
             self.monitor.write_events([
                 ("serving/ttft_ms", (req.ttft or 0.0) * 1e3, step),
                 ("serving/queue_wait_ms", (req.queue_wait or 0.0) * 1e3,
@@ -194,6 +238,12 @@ class ServingMetrics:
             "completed": len(done),
             "rejected": dict(self.rejected),
             "failed": self.failed,
+            "failed_reasons": dict(self.failed_reasons),
+            "preempted": self.preempted,
+            "deadline_expired": sum(
+                1 for r in done if r.finish_reason == FinishReason.DEADLINE),
+            "step_overruns": self.step_overruns,
+            "load_transitions": self.load_transitions,
             "new_tokens": new_tokens,
             "decode_steps": self.decode_steps,
             "tokens_per_decode_step": (

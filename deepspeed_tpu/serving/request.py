@@ -4,17 +4,28 @@ A :class:`Request` is one user generation job moving through the
 lifecycle ``QUEUED -> [PREFILLING ->] RUNNING -> FINISHED`` (or
 ``REJECTED`` straight out of admission control; ``PREFILLING`` is the
 stall-free chunked-admission stage for prompts longer than the serving
-engine's chunk width). The object doubles as the per-request SLO
-record: the scheduler stamps wall-clock times at each transition and the
-latency metrics (TTFT, queue wait, per-token latency) are derived
-properties, so there is exactly one place timing truth lives.
+engine's chunk width; preemption sends a seated request back to
+``QUEUED`` carrying its generated-so-far tokens). The object doubles as
+the per-request SLO record: the scheduler stamps wall-clock times at
+each transition and the latency metrics (TTFT, queue wait, per-token
+latency) are derived properties, so there is exactly one place timing
+truth lives.
+
+Terminal reasons are CLOSED ENUMS (:class:`FinishReason`,
+:class:`RejectReason`), not free-form strings: every monitor event,
+stats key and timeline attribute derives from them, and
+:class:`~deepspeed_tpu.serving.metrics.ServingMetrics` validates each
+recorded reason against the enum so a typo'd reason fails loudly at the
+emit site instead of silently forking a new metrics series. Both enums
+are ``str`` subclasses, so ``req.finish_reason == "eos"`` keeps
+working everywhere.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import List, Optional
+from typing import List, Optional, Union
 
 import numpy as np
 
@@ -29,7 +40,47 @@ class RequestState(enum.Enum):
     FAILED = "failed"       # aborted by a mid-step engine exception
 
 
-@dataclasses.dataclass
+class FinishReason(str, enum.Enum):
+    """Why a request left via FINISHED (or FAILED — the error reasons).
+
+    ``str`` mixin: members compare and format as their values, so
+    existing ``finish_reason == "eos"`` comparisons and f-string tags
+    are unchanged.
+    """
+
+    EOS = "eos"                          # emitted its eos_token_id
+    LENGTH = "length"                    # hit max_new_tokens
+    LENGTH_CAP = "length_cap"            # cache row full (capacity)
+    DEADLINE = "deadline"                # per-request deadline expired
+    ERROR = "error"                      # mid-step engine exception
+    NUMERICAL_ERROR = "numerical_error"  # NaN/inf logits in this slot
+
+    __str__ = str.__str__  # "eos", not "FinishReason.EOS" (py<3.11 quirk)
+
+    @classmethod
+    def of(cls, value: Union[str, "FinishReason"]) -> "FinishReason":
+        """Validate/coerce; raises ``ValueError`` on unknown reasons."""
+        return cls(value)
+
+
+class RejectReason(str, enum.Enum):
+    """Why admission control refused a submission."""
+
+    QUEUE_FULL = "queue_full"            # bounded queue at depth
+    PROMPT_TOO_LONG = "prompt_too_long"  # can never fit the KV capacity
+    RETRY_AFTER = "retry_after"          # shed by overload degradation;
+    #                                      retry_after_s carries the hint
+
+    __str__ = str.__str__
+
+    @classmethod
+    def of(cls, value: Union[str, "RejectReason"]) -> "RejectReason":
+        return cls(value)
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: a generated __eq__
+#                                   would elementwise-compare numpy prompts
+#                                   (ambiguous truth) and drop hashability
 class Request:
     """One generation request plus its lifecycle/metric record.
 
@@ -46,13 +97,19 @@ class Request:
     eos_token_id: Optional[int] = None
 
     state: RequestState = RequestState.QUEUED
-    reject_reason: Optional[str] = None     # "queue_full" | "prompt_too_long"
-    finish_reason: Optional[str] = None     # "eos" | "length" | "length_cap"
-    #                                         | "error"
+    reject_reason: Optional[RejectReason] = None
+    finish_reason: Optional[FinishReason] = None
     slot: Optional[int] = None
-    prefill_pos: int = 0                    # prompt tokens already written
+    prefill_pos: int = 0                    # seed tokens already written
     #                                         into the slot (chunked prefill)
     output_tokens: List[int] = dataclasses.field(default_factory=list)
+
+    # -- resilience -----------------------------------------------------
+    deadline_ms: Optional[float] = None     # TTL from submit; None = none
+    deadline_time: Optional[float] = None   # absolute perf_counter stamp
+    retry_after_s: Optional[float] = None   # backoff hint on RETRY_AFTER
+    preemptions: int = 0                    # times bounced back to QUEUED
+    last_admit_step: int = -1               # engine step_id of last seating
 
     # telemetry counters (per-request lifecycle accounting)
     chunks: int = 0                         # chunked-prefill dispatches run
@@ -74,6 +131,27 @@ class Request:
         return np.concatenate(
             [np.asarray(self.prompt, np.int32),
              np.asarray(self.output_tokens, np.int32)])
+
+    # -- preemption resume ---------------------------------------------
+    @property
+    def seed_tokens(self) -> np.ndarray:
+        """What admission must prefill into a slot: the prompt, plus —
+        after a preemption — everything generated so far. The last
+        generated token has never been fed through the model (the
+        decode loop feeds it next), so re-prefilling the FULL history
+        and sampling at its last position produces exactly the token
+        the next decode step would have: greedy output is bitwise
+        identical across preemptions."""
+        return self.tokens() if self.output_tokens else \
+            np.asarray(self.prompt, np.int32)
+
+    @property
+    def seed_len(self) -> int:
+        return self.prompt_len + len(self.output_tokens)
+
+    def expired(self, now: float) -> bool:
+        """Deadline passed? (False when no deadline is set.)"""
+        return self.deadline_time is not None and now >= self.deadline_time
 
     # -- derived SLO metrics (seconds; None until the inputs exist) ----
     @property

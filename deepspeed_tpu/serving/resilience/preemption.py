@@ -1,0 +1,47 @@
+"""Victim selection for slot preemption.
+
+When the engine must free a slot (queue pressure past the configured
+threshold, or an operator calling ``preempt()``), the victim policy
+decides WHO loses their seat. The policy is youngest / lowest-progress
+first: preempting the request with the fewest generated tokens wastes
+the least completed work (its whole history is re-prefilled on resume,
+so sunk cost is proportional to progress), and among equals the most
+recently admitted goes first (it has waited the least and its
+re-queue-at-the-front costs the least extra latency).
+
+Requests admitted fewer than ``min_run_steps`` steps ago are
+ineligible — a freshly seated request must make SOME progress before
+it can be bounced again, or pressure-preemption degenerates into
+admission thrash that generates zero tokens.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..request import Request, RequestState
+
+#: preemptable lifecycle states (QUEUED has no slot; terminal states
+#: have nothing left to free)
+PREEMPTABLE_STATES = (RequestState.RUNNING, RequestState.PREFILLING)
+
+
+def select_victims(candidates: Iterable[Request], n: int = 1,
+                   current_step: int = 0,
+                   min_run_steps: int = 2) -> List[Request]:
+    """Rank preemption candidates youngest/lowest-progress first and
+    return up to ``n`` eligible victims.
+
+    ``candidates`` are seated requests (RUNNING or PREFILLING);
+    anything else is skipped. Eligibility additionally requires the
+    request to have held its slot for at least ``min_run_steps``
+    scheduler steps (``current_step - last_admit_step``)."""
+    eligible = [
+        r for r in candidates
+        if r.state in PREEMPTABLE_STATES
+        and (current_step - r.last_admit_step) >= min_run_steps]
+    # fewest generated tokens first (least sunk work), then most recent
+    # admission, then newest request id — a total, deterministic order
+    eligible.sort(key=lambda r: (len(r.output_tokens), -r.last_admit_step,
+                                 -r.request_id))
+    return eligible[:max(n, 0)]

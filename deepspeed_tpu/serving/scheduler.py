@@ -28,7 +28,7 @@ from __future__ import annotations
 import collections
 from typing import Deque, List, Optional, Tuple
 
-from .request import Request, RequestState
+from .request import RejectReason, Request, RequestState
 
 POLICIES = ("continuous", "gang")
 
@@ -52,26 +52,57 @@ class FIFOScheduler:
         return len(self.queue)
 
     # ------------------------------------------------------------------
-    def submit(self, req: Request) -> Tuple[bool, Optional[str]]:
+    def submit(self, req: Request) -> Tuple[bool, Optional[RejectReason]]:
         """Admission control. Returns ``(accepted, reject_reason)``;
-        accepted requests join the FIFO queue."""
+        accepted requests join the FIFO queue. Capacity is checked
+        against the request's FULL footprint (seed + remaining budget) so
+        a preempted request that could never finish is refused rather
+        than admitted to die at the length cap."""
         if self.capacity is not None and \
-                req.prompt_len + req.max_new_tokens > self.capacity:
-            return False, "prompt_too_long"
+                req.seed_len + req.max_new_tokens - len(req.output_tokens) \
+                > self.capacity:
+            return False, RejectReason.PROMPT_TOO_LONG
         if len(self.queue) >= self.max_queue_depth:
-            return False, "queue_full"
+            return False, RejectReason.QUEUE_FULL
         req.state = RequestState.QUEUED
         self.queue.append(req)
         return True, None
 
     def requeue_front(self, reqs: List[Request]) -> None:
-        """Put granted-but-never-admitted requests back at the HEAD of the
-        queue in their original order (step-abort recovery: they lost
-        nothing but their place in line, so they keep it). Bypasses
-        admission control — these requests already passed it."""
+        """Put granted-but-never-admitted (or manually preempted)
+        requests back at the HEAD of the queue, preserving their
+        RELATIVE order: after ``requeue_front([a, b])`` the queue pops
+        ``a`` then ``b`` then whatever was already waiting. The reversed
+        ``appendleft`` walk is what makes that hold — appendleft-ing in
+        forward order would reverse the batch, a FIFO inversion that
+        reorders same-step aborted grants on re-admission (pinned by a
+        regression test). Bypasses admission control — these requests
+        already passed it."""
         for r in reversed(reqs):
             r.state = RequestState.QUEUED
             self.queue.appendleft(r)
+
+    def requeue_back(self, reqs: List[Request]) -> None:
+        """Requeue at the TAIL — the automatic pressure-preemption path.
+        A pressure victim must NOT go to the head: the very next grant
+        would hand it back its own freed slot (a swap loop that preempts
+        forever and generates nothing). Sending it behind the arrivals
+        that caused the pressure yields round-robin time-slicing
+        instead. Bypasses admission control, like ``requeue_front``."""
+        for r in reqs:
+            r.state = RequestState.QUEUED
+            self.queue.append(r)
+
+    def expire(self, now: float) -> List[Request]:
+        """Remove and return queued requests whose deadline has passed —
+        a request that expired while WAITING should not cost a slot and
+        a prefill before being retired. The engine stamps these
+        ``finish_reason="deadline"`` through the normal retire path."""
+        expired = [r for r in self.queue if r.expired(now)]
+        if expired:
+            self.queue = collections.deque(
+                r for r in self.queue if not r.expired(now))
+        return expired
 
     def grant(self, free_slots: int, live_slots: int,
               token_budget: Optional[int] = None,

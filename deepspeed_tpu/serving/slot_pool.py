@@ -233,6 +233,28 @@ class SlotPool:
         cs["index"] = self._index_from_mirror()
         self.cache = {"cache_store": cs}
 
+    def consistency_errors(self) -> list:
+        """Internal-bookkeeping audit for ``check_invariants()``: the
+        free heap and its set mirror must agree exactly and every free
+        slot must be a valid id. Returns human-readable violation
+        strings (empty = healthy) instead of raising, so the engine can
+        aggregate pool problems with its own request/slot cross-checks."""
+        errors = []
+        if len(self._free) != len(self._free_set):
+            errors.append(f"free heap ({len(self._free)}) and free set "
+                          f"({len(self._free_set)}) sizes differ")
+        if set(self._free) != self._free_set:
+            errors.append(f"free heap {sorted(self._free)} != free set "
+                          f"{sorted(self._free_set)}")
+        bad = [s for s in self._free_set
+               if not 0 <= s < self.num_slots]
+        if bad:
+            errors.append(f"free slots out of range: {sorted(bad)}")
+        if len(set(self._free)) != len(self._free):
+            errors.append(f"duplicate slots in free heap: "
+                          f"{sorted(self._free)}")
+        return errors
+
     def positions(self) -> np.ndarray:
         """(num_slots,) decode positions, clamped into the allocation so
         long-dead slots can't push position-embedding lookups or cache

@@ -47,7 +47,15 @@ class Drafter:
                 ) -> Tuple[np.ndarray, np.ndarray]:
         """``histories[slot]`` is prompt+generated tokens (int32, includes
         the not-yet-decoded current token) or ``None`` for a dead slot.
-        Returns ``(tokens (num_slots, k) int32, counts (num_slots,) int32)``."""
+        Returns ``(tokens (num_slots, k) int32, counts (num_slots,) int32)``.
+
+        Failure contract: ``propose`` runs inside the serving engine's
+        exception-safe step — a drafter that raises aborts the step
+        cleanly (``ServingEngine._abort_step``: no slot leaks, running
+        requests FAIL with ``finish_reason="error"``, the error
+        propagates to the caller). A drafter that cannot produce drafts
+        should return ``counts`` of zeros instead of raising — zero-draft
+        rows reduce verify to plain decode at zero extra cost."""
         raise NotImplementedError
 
 

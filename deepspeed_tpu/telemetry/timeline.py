@@ -77,6 +77,17 @@ class TimelineStore:
         tl = self.get(request_id)
         return [e["event"] for e in tl] if tl else []
 
+    def open_ids(self) -> List[int]:
+        """Request ids that never saw a terminal event — the timeline
+        COMPLETENESS check the chaos harness asserts against: after a
+        drain, every submitted request must have ended in a terminal
+        event (finished/rejected/failed), so this must be empty. A
+        non-empty result names the requests whose lifecycle was dropped
+        on the floor."""
+        with self._lock:
+            return [rid for rid, tl in self._timelines.items()
+                    if tl["open"]]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._timelines)

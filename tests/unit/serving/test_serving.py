@@ -188,9 +188,12 @@ def test_scheduler_unit():
     with pytest.raises(ValueError, match="policy"):
         FIFOScheduler(2, 2, policy="nope", capacity=32)
 
-    class R:  # minimal stand-in
+    class R:  # minimal stand-in (the admission surface of Request:
+        # capacity charges seed + REMAINING budget, see Scheduler.submit)
         def __init__(self, n, m):
             self.prompt_len, self.max_new_tokens = n, m
+            self.output_tokens = []
+            self.seed_len = n
 
     ok, _ = sched.submit(R(4, 4))
     assert ok

@@ -123,6 +123,50 @@ class TestCheckRegression:
         assert r.returncode == 2
         assert "recompiles_after_warmup" in r.stderr
 
+    @staticmethod
+    def _chaos(value=1.0, leaks=0, inv=True, tl=True):
+        return {"value": value,
+                "detail": {"slot_leaks": leaks, "invariants_ok": inv,
+                           "timelines_complete": tl}}
+
+    def test_zero_leaks_clean_passes(self, tmp_path):
+        base = _write(tmp_path, "base.json", self._chaos())
+        cand = _write(tmp_path, "cand.json", self._chaos())
+        r = _run(base, cand, "--require-zero-leaks")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "slot_leaks" in r.stdout
+
+    def test_zero_leaks_leaked_slot_fails(self, tmp_path):
+        # absolute gate: one leaked slot fails even with value improved
+        base = _write(tmp_path, "base.json", self._chaos(value=1.0))
+        cand = _write(tmp_path, "cand.json", self._chaos(value=2.0, leaks=1))
+        r = _run(base, cand, "--require-zero-leaks")
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout
+
+    def test_zero_leaks_invariant_failure_fails(self, tmp_path):
+        base = _write(tmp_path, "base.json", self._chaos())
+        cand = _write(tmp_path, "cand.json", self._chaos(inv=False))
+        assert _run(base, cand, "--require-zero-leaks").returncode == 1
+
+    def test_zero_leaks_open_timeline_fails(self, tmp_path):
+        base = _write(tmp_path, "base.json", self._chaos())
+        cand = _write(tmp_path, "cand.json", self._chaos(tl=False))
+        assert _run(base, cand, "--require-zero-leaks").returncode == 1
+
+    def test_zero_leaks_non_boolean_exits_2(self, tmp_path):
+        # "true"-the-string must not pass as true-the-boolean
+        base = _write(tmp_path, "base.json", self._chaos())
+        cand = _write(tmp_path, "cand.json", self._chaos(inv="true"))
+        r = _run(base, cand, "--require-zero-leaks")
+        assert r.returncode == 2
+        assert "invariants_ok" in r.stderr
+
+    def test_zero_leaks_missing_field_exits_2(self, tmp_path):
+        base = _write(tmp_path, "base.json", self._chaos())
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        assert _run(base, cand, "--require-zero-leaks").returncode == 2
+
 
 class TestBenchEntryPoints:
     def test_serving_stall_entry_wired(self):
@@ -133,6 +177,19 @@ class TestBenchEntryPoints:
         assert "def serving_stall_main" in src
         assert "--trace" in src
         assert "recompiles_after_warmup" in src
+
+    def test_serving_chaos_entry_wired(self):
+        # the chaos row must exist, must be dispatched BEFORE the plain
+        # "serving" check (exact-element matching would otherwise never
+        # reach it), and must emit every invariant --require-zero-leaks
+        # gates on
+        src = (REPO / "bench.py").read_text()
+        assert "def serving_chaos_main" in src
+        assert src.index('"serving-chaos" in argv') \
+            < src.index('"serving-stall" in argv')
+        for key in ("slot_leaks", "invariants_ok", "timelines_complete",
+                    "goodput"):
+            assert key in src
 
     def test_check_regression_importable(self):
         # the module must import without side effects (argparse only
