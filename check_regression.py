@@ -23,6 +23,19 @@ not exceed N. This is an absolute cap on the candidate alone — no
 baseline comparison and no threshold slack, because post-warmup
 recompiles are a zero-tolerance invariant, not a noisy measurement.
 
+The ``paging`` row gates through the same machinery — e.g.::
+
+    python check_regression.py BENCH_paging.base.json BENCH_paging.json \
+        --metric value:higher \
+        --metric detail.prefill_hit_ms:lower \
+        --metric detail.prefix_hit_rate:higher \
+        --max-recompiles 0
+
+``value`` is peak resident requests at equal KV HBM (paged over
+contiguous; the PR-7 acceptance floor is 1.5), ``prefill_hit_ms`` is
+the admit-to-first-token latency a prefix hit pays, and the recompile
+cap holds across page churn, prefix hits, and copy-on-write forks.
+
 ``--require-zero-leaks`` gates the fault-tolerance invariants the
 ``serving-chaos`` row reports: the candidate's ``detail.slot_leaks``
 must be exactly 0 and ``detail.invariants_ok`` /

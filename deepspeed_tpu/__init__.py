@@ -151,7 +151,18 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
     ``preempt_queue_threshold`` / ``preempt_min_run_steps`` (automatic
     pressure preemption), and ``fault_injector`` (a
     :class:`serving.resilience.FaultInjector` for chaos testing).
-    Per-request ``deadline_ms`` rides on ``submit()``."""
+    Per-request ``deadline_ms`` rides on ``submit()``.
+
+    ``paged_kv`` replaces the per-slot contiguous KV rows with a
+    :class:`serving.PagedKVPool` — fixed-size refcounted pages behind a
+    static per-slot page table, with radix-trie prefix caching and
+    copy-on-write sharing (vLLM PagedAttention + SGLang RadixAttention;
+    greedy output stays bitwise identical). ``True`` for defaults (page
+    size = the prefill chunk, ``num_pages`` = worst-case), or a dict
+    ``{"num_pages": int, "page_size": int, "prefix_cache": bool}`` —
+    ``num_pages`` below ``num_slots * max_seq_len / page_size``
+    oversubscribes HBM; pressure is drained by trie eviction, then
+    automatic preemption."""
     from .serving.engine import ServingEngine
 
     serve_keys = ("policy", "do_sample", "temperature", "top_k", "top_p",
@@ -161,7 +172,7 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
                   "deadline_default_ms", "step_wall_budget_ms",
                   "guard_numerics", "degradation",
                   "preempt_queue_threshold", "preempt_min_run_steps",
-                  "fault_injector")
+                  "fault_injector", "paged_kv")
     serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
     engine = init_inference(model=model, config=config, **kwargs)
     return ServingEngine(engine, num_slots=num_slots,

@@ -636,6 +636,59 @@ class KVCacheSpec:
             cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
         return cache
 
+    # -- paged KV (PagedAttention-style block pool) --------------------
+    def paged_cache(self, num_pages: int, page_size: int) -> dict:
+        """Zeroed PAGE-POOL k/v arrays: the positions axis is split into
+        ``num_pages`` physical pages of ``page_size`` columns each, with
+        NO batch axis — k/v (L, P, KV, cache_d, page_size) [+ scales
+        (L, P, KV, page_size)]. A per-slot page table maps logical
+        positions to pages; :meth:`dense_from_pages` reassembles the
+        ``stacked_cache`` layout the attention kernels consume. Same
+        dtype/packing tiers as the contiguous container (int8/packed
+        cache columns page exactly like full-precision ones)."""
+        shape = (self.n_layer, num_pages, self.kv_heads, self.cache_d,
+                 page_size)
+        cache = {"k": jnp.zeros(shape, self.dtype),
+                 "v": jnp.zeros(shape, self.dtype)}
+        if self.quantized:
+            sshape = (self.n_layer, num_pages, self.kv_heads, page_size)
+            cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        return cache
+
+    def dense_from_pages(self, paged: dict, table) -> dict:
+        """Traced paged-attention GATHER: reassemble the dense
+        ``(L, B, KV, cache_d, max_seq_len)`` view of a page pool from a
+        ``(B, max_pages_per_slot)`` int32 page table, so the existing
+        attention programs (decode / verify / chunked prefill) run
+        UNCHANGED over paged storage — bitwise-identical math, static
+        shapes, zero new attention kernels. Unmapped entries carry the
+        sentinel ``num_pages``; the clip-mode gather reads an arbitrary
+        real page there, which is safe because a slot's mapped region
+        always covers its live ``[0, index)`` columns and attention
+        masks everything beyond (the same alive-masking that makes dead
+        slots free). ``table`` rows must span exactly
+        ``max_seq_len // page_size`` pages."""
+        B, max_pages = table.shape
+        flat = table.reshape(-1)
+        out = {}
+        for key in ("k", "v"):
+            leaf = paged[key]                       # (L, P, KV, cd, ps)
+            L, _, KV, cd, ps = leaf.shape
+            g = jnp.take(leaf, flat, axis=1, mode="clip")
+            g = g.reshape(L, B, max_pages, KV, cd, ps)
+            out[key] = g.transpose(0, 1, 3, 4, 2, 5).reshape(
+                L, B, KV, cd, max_pages * ps)
+        if self.quantized:
+            for key in ("k_scale", "v_scale"):
+                leaf = paged[key]                   # (L, P, KV, ps)
+                L, _, KV, ps = leaf.shape
+                g = jnp.take(leaf, flat, axis=1, mode="clip")
+                g = g.reshape(L, B, max_pages, KV, ps)
+                out[key] = g.transpose(0, 1, 3, 2, 4).reshape(
+                    L, B, KV, max_pages * ps)
+        return out
+
 
 def make_kv_cache_spec(cfg: TransformerConfig) -> KVCacheSpec:
     cache_dtype, cache_d, packed = kv_cache_spec(cfg)

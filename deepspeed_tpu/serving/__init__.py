@@ -6,15 +6,19 @@ whole-batch decoder into a request-level server: a FIFO admission queue
 from the module's declared :func:`kv_cache_spec` (:mod:`.slot_pool`),
 iteration-level scheduling with per-request SLO metrics
 (:mod:`.engine`, :mod:`.metrics`), optional draft–verify speculative
-decoding over the same fixed shapes (:mod:`.spec_decode`), and the
+decoding over the same fixed shapes (:mod:`.spec_decode`), the
 fault-tolerance layer — deadlines, preemption, graceful degradation,
-deterministic fault injection (:mod:`.resilience`).
+deterministic fault injection (:mod:`.resilience`) — and paged KV with
+refcounted copy-on-write prefix caching (:mod:`.paged_pool`,
+:mod:`.prefix_cache`; ``paged_kv=True``).
 Entry point: ``deepspeed_tpu.init_serving(...)`` or
 :class:`ServingEngine` directly.
 """
 
 from .engine import ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .paged_pool import PagedKVPool, PagePoolExhausted  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
 from .request import (FinishReason, RejectReason, Request,  # noqa: F401
                       RequestState)
 from .resilience import (DegradationConfig, FaultInjector,  # noqa: F401
@@ -27,6 +31,7 @@ from .spec_decode import (  # noqa: F401
 
 __all__ = ["ServingEngine", "ServingMetrics", "Request", "RequestState",
            "FinishReason", "RejectReason", "FIFOScheduler", "SlotPool",
+           "PagedKVPool", "PagePoolExhausted", "PrefixCache",
            "SpecDecodeConfig", "Drafter", "NGramDrafter",
            "SmallModelDrafter", "DegradationConfig", "FaultInjector",
            "InjectedFault", "InvariantViolation", "LoadState",

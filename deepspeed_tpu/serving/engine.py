@@ -88,6 +88,7 @@ from ..telemetry import (MetricsRegistry, RecompileWatchdog, TimelineStore,
                          Tracer)
 from ..utils.logging import log_dist
 from .metrics import ServingMetrics
+from .paged_pool import PagedKVPool, PagePoolExhausted
 from .request import FinishReason, RejectReason, Request, RequestState
 from .resilience import (DegradationConfig, FaultInjectingDrafter,
                          InvariantViolation, LoadState, LoadStateMachine,
@@ -96,11 +97,14 @@ from .scheduler import FIFOScheduler
 from .slot_pool import SlotPool
 
 # jitted entry points the recompile watchdog wraps; verify_k is created
-# lazily on first use, so _ensure_watch re-checks the list every step
+# lazily on first use, so _ensure_watch re-checks the list every step.
+# The paged entries only exist on a PagedKVPool (attach skips absentees).
 _WATCHED_ENGINE_JITS = ("_jit_prefill_at", "_jit_decode",
                         "_jit_prefill_chunk", "_jit_sample",
                         "_jit_verify_k", "_jit_decode_scan")
-_WATCHED_POOL_JITS = ("_admit_jit", "_admit_rows_jit")
+_WATCHED_POOL_JITS = ("_admit_jit", "_admit_rows_jit",
+                      "_paged_decode_jit", "_paged_verify_jit",
+                      "_paged_chunk_jit", "_jit_copy_page")
 _WATCHED_SERVING_JITS = ("_jit_finite",)
 
 _MIN_PREFILL_BUCKET = 16
@@ -135,7 +139,8 @@ class ServingEngine:
                  degradation: Optional[Any] = None,
                  preempt_queue_threshold: Optional[int] = None,
                  preempt_min_run_steps: int = 2,
-                 fault_injector: Optional[Any] = None):
+                 fault_injector: Optional[Any] = None,
+                 paged_kv: Any = False):
         self.engine = engine
         # materialize params + jits before sizing anything off the module
         engine._ensure_params(jnp.zeros((1, 2), jnp.int32))
@@ -157,7 +162,33 @@ class ServingEngine:
         if getattr(engine, "mesh", None) is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             rep = NamedSharding(engine.mesh, PartitionSpec())
-        self.pool = SlotPool(spec, num_slots, sharding=rep)
+        # -- paged KV (ISSUE 7): page-pooled storage + prefix cache ----
+        # paged_kv: False (contiguous rows), True (paged, defaults), or a
+        # dict {"num_pages": int, "page_size": int, "prefix_cache": bool}
+        capacity = int(spec.max_seq_len)
+        if paged_kv:
+            knobs = dict(paged_kv) if isinstance(paged_kv, dict) else {}
+            page_size = knobs.pop("page_size", None)
+            if page_size is None:
+                # default: the prefill chunk width (ISSUE 7) — one chunk
+                # fills one page — auto-halved the same way the chunk is
+                # until it divides the capacity
+                page_size = int(prefill_chunk) if prefill_chunk > 0 else 64
+                page_size = max(1, min(page_size, capacity))
+                while page_size > 1 and capacity % page_size != 0:
+                    page_size //= 2
+            num_pages = knobs.pop("num_pages", None)
+            use_prefix = bool(knobs.pop("prefix_cache", True))
+            if knobs:
+                raise ValueError(f"unknown paged_kv keys: {sorted(knobs)}; "
+                                 f"expected num_pages/page_size/"
+                                 f"prefix_cache")
+            self.pool = PagedKVPool(spec, num_slots, num_pages=num_pages,
+                                    page_size=int(page_size), sharding=rep,
+                                    prefix_cache=use_prefix)
+        else:
+            self.pool = SlotPool(spec, num_slots, sharding=rep)
+        self._paged = isinstance(self.pool, PagedKVPool)
         self._spec = None
         self._drafter = None
         sched_capacity = self.pool.capacity
@@ -174,9 +205,16 @@ class ServingEngine:
                 # inside the allocation, so the dynamic-slice writes can
                 # never clamp into another request's live columns.
                 sched_capacity = self.pool.capacity - sc.k
-        self.scheduler = FIFOScheduler(num_slots, max_queue_depth,
-                                       policy=policy,
-                                       capacity=sched_capacity)
+        self.scheduler = FIFOScheduler(
+            num_slots, max_queue_depth, policy=policy,
+            capacity=sched_capacity,
+            # page-denominated admission (oversubscription makes row
+            # capacity a fiction): reject what the whole pool could
+            # never hold; spec decode's k-past-the-index verify writes
+            # are headroom columns, mirroring the row-capacity reserve
+            page_size=self.pool.page_size if self._paged else None,
+            num_pages=self.pool.num_pages if self._paged else None,
+            page_headroom=(self._spec.k if self._spec is not None else 0))
         # -- telemetry -------------------------------------------------
         # the tracer defaults to DISABLED: span() then costs one branch
         # + a shared null span, keeping the instrumented hot path within
@@ -193,6 +231,10 @@ class ServingEngine:
             strict=strict_recompile, step_fn=lambda: self.step_id)
         self.metrics = ServingMetrics(monitor, registry=self.registry,
                                       step_fn=lambda: self.step_id)
+        if self._paged:
+            # pool-internal events (CoW copies, trie evictions) land in
+            # the same registry as the engine-side paging/* series
+            self.pool.registry = self.registry
         # -- resilience ------------------------------------------------
         if deadline_default_ms is not None and deadline_default_ms <= 0:
             raise ValueError(f"deadline_default_ms must be > 0, got "
@@ -251,6 +293,14 @@ class ServingEngine:
             self.prefill_token_budget = budget
         else:
             self.prefill_token_budget = None
+        # prefix-hit seating rides the chunked-prefill path (a hit seats
+        # PREFILLING at its uncached suffix), so it needs stall-free mode
+        self._use_prefix = (self._paged and self.pool.prefix is not None
+                            and self._stall_free)
+        if self._paged:
+            # build the paged gather/scatter jits now so _ensure_watch
+            # wraps them before any traffic
+            self.pool.bind_engine(engine)
         # FIFO of seated PREFILLING requests whose prompts are still
         # streaming in chunk by chunk; step() advances the head only
         self._prefill_queue: List[Request] = []
@@ -435,6 +485,10 @@ class ServingEngine:
             req.first_token_time = first0
             del req.output_tokens[n0:]
             raise
+        if self._use_prefix:
+            # publish the freshly-prefilled full prompt pages (refcounted
+            # past this slot's lifetime) for the next same-prefix request
+            self.pool.cache_prefix(slot, seed)
         self._maybe_retire(req, token, finished)
 
     def _running_count(self) -> int:
@@ -452,6 +506,125 @@ class ServingEngine:
             return self._bucket(T, self.pool.capacity)
         return self.prefill_chunk
 
+    # -- paged KV: page accounting and prefix-hit seating --------------
+    def _prefix_plan(self, hit_tokens: int, seed_len: int) -> int:
+        """Where a prefix-hit admission starts prefilling. A full hit
+        still re-prefills the LAST chunk (the final-chunk logits sample
+        the first token, exactly like a cold chunked admission — bitwise
+        parity); the start is aligned DOWN to a chunk multiple so every
+        chunk keeps the start+chunk <= capacity invariant the chunk
+        program's update-slice relies on."""
+        C = max(self.prefill_chunk, 1)
+        if hit_tokens >= seed_len:
+            pos0 = seed_len - min(C, seed_len)
+        else:
+            pos0 = min(hit_tokens, seed_len)
+        return (pos0 // C) * C
+
+    def _page_cost(self, req: Request) -> int:
+        """FRESH pages seating this request allocates right now: the
+        pages covering its uncached suffix (CoW forks included; shared
+        prefix pages are free — a refcount bump). Decode-time growth is
+        deliberately NOT charged — that is the oversubscription bet,
+        underwritten by trie eviction + pressure preemption."""
+        ps = self.pool.page_size
+        seed = req.seed_len
+        pos0 = 0
+        if self._use_prefix:
+            hit = self.pool.prefix.peek(req.seed_tokens) * ps
+            pos0 = self._prefix_plan(hit, seed)
+        return (seed - 1) // ps - pos0 // ps + 1
+
+    def _grant_page_budget(self) -> int:
+        """Pages the grant may promise this step: free now, plus what
+        trie eviction could reclaim without preempting anyone."""
+        return self.pool.free_page_count + self.pool.evictable_page_count()
+
+    def _ensure_pages(self, slot: int, start: int, end: int) -> None:
+        """ensure_writable with the pressure valve: on PagePoolExhausted
+        (free list empty AND trie eviction dry), preempt the youngest
+        OTHER seated request — its pages come back to the free list —
+        and retry. Only when no victim remains does the exhaustion
+        propagate (a sizing bug: one request's footprint exceeds the
+        whole pool, which the submit-time page check rejects)."""
+        while True:
+            try:
+                self.pool.ensure_writable(slot, start, end)
+                return
+            except PagePoolExhausted:
+                victims = [
+                    r for r in select_victims(
+                        list(self._slot_req.values()),
+                        n=len(self._slot_req), current_step=self.step_id,
+                        min_run_steps=0)
+                    if r.slot != slot]
+                if not victims:
+                    raise
+                self._preempt_req(victims[0], auto=True)
+
+    def _ensure_decode_pages(self, width: int) -> None:
+        """Back every RUNNING slot's next ``width`` write columns with
+        exclusively-owned pages before the decode/verify dispatch.
+        PREFILLING slots are skipped on purpose: their masked garbage
+        writes hit unmapped entries (scatter drops them) or pages the
+        seating already CoW-forked — allocating for garbage would waste
+        pages under pressure."""
+        for slot, req in list(self._slot_req.items()):
+            if req.state is RequestState.RUNNING:
+                idx = int(self.pool.starts[slot])
+                self._ensure_pages(slot, idx, idx + width)
+
+    def _admit_prefix_hit(self, req: Request) -> bool:
+        """Try to seat ``req`` through the prefix cache: walk the trie,
+        map the cached pages into a fresh slot for free, and enter the
+        chunked-prefill path at the first uncached position. Returns
+        False on a miss (caller falls through to the cold paths)."""
+        pool = self.pool
+        seed = req.seed_tokens
+        seed_len = req.seed_len
+        pages = pool.prefix.match(seed)
+        hit = len(pages) * pool.page_size
+        pos0 = self._prefix_plan(hit, seed_len)
+        self.metrics.record_prefix(pos0, seed_len)
+        if pos0 <= 0:
+            return False     # nothing actually skipped: cold path
+        slot = pool.alloc()
+        try:
+            if self.faults is not None:
+                self.faults.check("admit_oom")
+            pool.reset_row(slot)
+            pool.seat_prefix(slot, pages, pos0)
+        except PagePoolExhausted:
+            # the uncached suffix needs more fresh pages than remain:
+            # release (unmapping anything seated so far) and retry next
+            # step once eviction/preemption has freed pages
+            pool.release(slot)
+            req.state = RequestState.QUEUED
+            req.slot = None
+            self.scheduler.requeue_front([req])
+            self.timelines.record(req.request_id, "requeued",
+                                  reason="page_pressure")
+            return True
+        except Exception:
+            pool.release(slot)
+            req.state = RequestState.QUEUED
+            req.slot = None
+            raise
+        req.admit_time = self._now()
+        req.slot = slot
+        req.prefill_pos = pos0
+        req.prefix_hit_tokens = pos0
+        req.state = RequestState.PREFILLING
+        req.last_admit_step = self.step_id
+        self._slot_req[slot] = req
+        self._prefill_queue.append(req)
+        self.timelines.record(req.request_id, "admitted", slot=slot,
+                              mode="prefix_hit")
+        self.timelines.record(req.request_id, "prefix_hit",
+                              hit_tokens=pos0, seed_len=seed_len)
+        self.tracer.flow("s", "req", req.request_id)
+        return True
+
     def _admit_stall_free(self, granted: List[Request],
                           finished: List[Request]) -> None:
         """Seat every granted request: long prompts become PREFILLING
@@ -460,6 +633,9 @@ class ServingEngine:
         prefilled + scattered in ONE batched dispatch."""
         groups: dict = {}
         for req in granted:
+            if self._use_prefix and self._admit_prefix_hit(req):
+                continue          # seated PREFILLING at its uncached
+            #                       suffix (or re-queued under pressure)
             T = req.seed_len
             if T > self.prefill_chunk:
                 slot = self.pool.alloc()
@@ -546,6 +722,8 @@ class ServingEngine:
                 if n0s[i] == 0:
                     self.timelines.record(req.request_id, "first_token")
                 self.tracer.flow("s", "req", req.request_id)
+                if self._use_prefix:
+                    self.pool.cache_prefix(slot, req.seed_tokens)
                 self._maybe_retire(req, token, finished)
         except Exception:
             # roll the whole group back to clean QUEUED requests so
@@ -581,11 +759,20 @@ class ServingEngine:
         ids[0, :L] = seed[pos:pos + L]
         running_before = self._running_count()
         t0 = self._now()
+        if self._paged:
+            # the chunk's write window must land in owned pages BEFORE
+            # the dispatch (allocating / CoW-forking under pressure may
+            # preempt a victim — host work, so it happens outside jit)
+            self._ensure_pages(slot, pos, pos + L)
         with self.tracer.span("serving/prefill_chunk", rid=req.request_id,
                               pos=pos, len=L):
-            logits, cache = self.engine.prefill_chunk(
-                self.pool.cache, ids, slot, pos, L, L - 1)
-        self.pool.cache = cache
+            if self._paged:
+                logits = self.pool.run_prefill_chunk(
+                    self.engine, ids, slot, pos, L, L - 1)
+            else:
+                logits, cache = self.engine.prefill_chunk(
+                    self.pool.cache, ids, slot, pos, L, L - 1)
+                self.pool.cache = cache
         self.pool.starts[slot] = pos + L  # device index moved in-program
         req.prefill_pos = pos + L
         req.chunks += 1
@@ -607,6 +794,8 @@ class ServingEngine:
             self._current[slot] = token
             if first:
                 self.timelines.record(req.request_id, "first_token")
+            if self._use_prefix:
+                self.pool.cache_prefix(slot, seed)
             self._maybe_retire(req, token, finished)
         else:
             # no sync: the chunk is enqueued and this step's decode
@@ -726,10 +915,19 @@ class ServingEngine:
         every slot is taken, evict ONE victim per step (youngest /
         least-progress first; must have held its slot for
         ``preempt_min_run_steps``). One per step is deliberate — paced
-        eviction keeps the batch mostly busy while pressure drains."""
+        eviction keeps the batch mostly busy while pressure drains.
+
+        With paged KV, page starvation counts as pressure too: free
+        slots are no help when the queue head's uncached suffix exceeds
+        every page the pool could free without a preemption."""
         if (self.preempt_queue_threshold is None
-                or self.scheduler.pending <= self.preempt_queue_threshold
-                or self.pool.free_count > 0):
+                or self.scheduler.pending <= self.preempt_queue_threshold):
+            return
+        starved = self.pool.free_count == 0
+        if not starved and self._paged and self.scheduler.queue:
+            starved = (self._page_cost(self.scheduler.queue[0])
+                       > self._grant_page_budget())
+        if not starved:
             return
         victims = select_victims(
             list(self._slot_req.values()), n=1, current_step=self.step_id,
@@ -763,6 +961,9 @@ class ServingEngine:
             tracer.counter("serving/occupancy", live=self.live_count,
                            pending=self.scheduler.pending)
             with tracer.span("serving/grant"):
+                page_budget = self._grant_page_budget() if self._paged \
+                    else None
+                page_cost = self._page_cost if self._paged else None
                 if self._stall_free:
                     # one chunk for the prefill-queue head will run this
                     # step; pre-charge it so admissions + chunk stay
@@ -771,10 +972,12 @@ class ServingEngine:
                     granted = self.scheduler.grant(
                         self.pool.free_count, self.live_count,
                         token_budget=self._effective_prefill_budget(),
-                        cost=self._admission_cost, spent=spent)
+                        cost=self._admission_cost, spent=spent,
+                        page_budget=page_budget, page_cost=page_cost)
                 else:
-                    granted = self.scheduler.grant(self.pool.free_count,
-                                                   self.live_count)
+                    granted = self.scheduler.grant(
+                        self.pool.free_count, self.live_count,
+                        page_budget=page_budget, page_cost=page_cost)
             try:
                 if self._stall_free:
                     self._admit_stall_free(granted, finished)
@@ -798,6 +1001,17 @@ class ServingEngine:
             except Exception:
                 self._abort_step(granted)
                 raise
+        if self._paged:
+            # per-step paging gauges (Prometheus export + dashboards):
+            # occupancy and sharing level of the page pool
+            free = self.pool.free_page_count
+            shared = int(np.sum(self.pool.page_refs > 1))
+            self.registry.gauge("paging/free_pages").set(float(free))
+            self.registry.gauge("paging/pages_in_use").set(
+                float(self.pool.num_pages - free))
+            self.registry.gauge("paging/refcounted_pages").set(float(shared))
+            tracer.counter("paging/pages", free=free,
+                           in_use=self.pool.num_pages - free, shared=shared)
         # strict-mode recompile gate sits at the step boundary: raising
         # mid-step would trigger _abort_step and FAIL innocent in-flight
         # requests, when the state is actually perfectly consistent
@@ -838,8 +1052,17 @@ class ServingEngine:
         gaps = self.metrics.step_gaps[-cfg.window:]
         p99 = float(np.percentile(np.asarray(gaps), 99) * 1e3) \
             if gaps else None
-        moved = self._load.update(self.scheduler.pending, p99,
-                                  step=self.step_id)
+        pending = self.scheduler.pending
+        if self._paged and self.scheduler.queue and \
+                self._page_cost(self.scheduler.queue[0]) \
+                > self._grant_page_budget():
+            # page starvation is load even when the queue is short: an
+            # oversubscribed pool that can't seat the queue head should
+            # trip the ladder (and its retry_after shedding) just like
+            # queue depth does, so degradation stays meaningful when
+            # pages — not slots — are the scarce resource
+            pending = max(pending, cfg.queue_pressured)
+        moved = self._load.update(pending, p99, step=self.step_id)
         self.tracer.counter("serving/load_state",
                             level=int(self._load.state))
         if moved is not None:
@@ -882,18 +1105,26 @@ class ServingEngine:
 
     def _decode_step(self, finished: List[Request], t0: float) -> None:
         eng = self.engine
+        if self._paged:
+            # page the write column in BEFORE snapshotting the running
+            # set: under pressure this can preempt a victim out of it
+            self._ensure_decode_pages(1)
         running = [(slot, req) for slot, req in self._slot_req.items()
                    if req.state is RequestState.RUNNING]
         tokens = jnp.asarray(self._current[:, None])
         pos = jnp.asarray(self.pool.positions())
         with self.tracer.span("serving/decode", live=len(running)):
-            logits, cache = eng._jit_decode(eng.params, self.pool.cache,
-                                            tokens, pos)
+            if self._paged:
+                logits = self.pool.run_decode(eng, tokens, pos)
+            else:
+                logits, cache = eng._jit_decode(eng.params, self.pool.cache,
+                                                tokens, pos)
         if self.faults is not None:
             logits, _ = self.faults.corrupt_logits(
                 logits, [slot for slot, _ in running])
         running = self._guard_logits(logits, running)
-        self.pool.cache = cache
+        if not self._paged:
+            self.pool.cache = cache
         if self._prefill_queue:
             # PREFILLING slots rode along as masked padding: the decode
             # program advanced every device index by 1, so overwrite from
@@ -924,6 +1155,11 @@ class ServingEngine:
         eng = self.engine
         K = self._spec.k
         B = self.pool.num_slots
+        if self._paged:
+            # verify writes K+1 columns past every RUNNING slot's index;
+            # page them in first (may preempt under pressure, so it runs
+            # before the drafter snapshots the live set)
+            self._ensure_decode_pages(K + 1)
 
         # PREFILLING slots keep histories[slot] = None: the drafter
         # proposes nothing for them (draft_len 0) and their deltas stay
@@ -952,13 +1188,21 @@ class ServingEngine:
         tokens = np.concatenate([self._current[:, None], draft], axis=1)
         self._rng, sub = jax.random.split(self._rng)
         with self.tracer.span("serving/verify_k", k=K):
-            cache, out, n_emit = eng.verify_k(
-                self.pool.cache, jnp.asarray(tokens),
-                jnp.asarray(self.pool.positions()), jnp.asarray(draft),
-                jnp.asarray(draft_len), sub,
-                jnp.asarray(self.temperature, jnp.float32), self._greedy,
-                int(self.top_k), float(self.top_p))
-        self.pool.cache = cache
+            if self._paged:
+                out, n_emit = self.pool.run_verify(
+                    eng, jnp.asarray(tokens),
+                    jnp.asarray(self.pool.positions()), jnp.asarray(draft),
+                    jnp.asarray(draft_len), sub,
+                    jnp.asarray(self.temperature, jnp.float32),
+                    self._greedy, int(self.top_k), float(self.top_p))
+            else:
+                cache, out, n_emit = eng.verify_k(
+                    self.pool.cache, jnp.asarray(tokens),
+                    jnp.asarray(self.pool.positions()), jnp.asarray(draft),
+                    jnp.asarray(draft_len), sub,
+                    jnp.asarray(self.temperature, jnp.float32),
+                    self._greedy, int(self.top_k), float(self.top_p))
+                self.pool.cache = cache
         with self.tracer.span("serving/sample"):
             # host sync: accepted tokens exist
             out = np.asarray(out)       # (B, K+1) emitted tokens per row
@@ -1138,5 +1382,10 @@ class ServingEngine:
             raise InvariantViolation(errors)
 
     def stats(self) -> dict:
-        """Aggregate SLO snapshot (see ServingMetrics.snapshot)."""
-        return self.metrics.snapshot()
+        """Aggregate SLO snapshot (see ServingMetrics.snapshot); with
+        paged KV a ``"paging"`` sub-dict carries the page-pool and
+        prefix-cache counters (see PagedKVPool.page_stats)."""
+        snap = self.metrics.snapshot()
+        if self._paged:
+            snap["paging"] = self.pool.page_stats()
+        return snap

@@ -70,6 +70,13 @@ class ServingMetrics:
         self.prefill_dispatches: int = 0
         self.prefill_time: float = 0.0
         self.stall_time: float = 0.0
+        # paged-KV prefix cache (admission-time trie lookups): hit
+        # tokens are seed tokens whose prefill was SKIPPED by mapping
+        # cached pages — the TTFT lever the paging bench row measures
+        self.prefix_lookups: int = 0
+        self.prefix_hits: int = 0
+        self.prefix_hit_tokens: int = 0
+        self.prefix_lookup_tokens: int = 0
         # whole-step wall times for steps where a RUNNING request was
         # waiting at step start: each is one user-visible inter-token
         # gap, admissions included. The per-request mean (per_token_*)
@@ -186,6 +193,25 @@ class ServingMetrics:
         if blocking:
             self.stall_time += seconds
 
+    def record_prefix(self, hit_tokens: int, seed_len: int) -> None:
+        """One admission-time prefix-cache lookup: ``hit_tokens`` of the
+        ``seed_len``-token seed were served from cached pages (0 = miss).
+        Mirrored into the registry as ``paging/*`` counters so the
+        Prometheus export carries the hit ratio."""
+        self.prefix_lookups += 1
+        self.prefix_lookup_tokens += seed_len
+        if hit_tokens > 0:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += hit_tokens
+            self._inc("paging/prefix_hits")
+            self._inc("paging/prefix_hit_tokens", hit_tokens)
+        else:
+            self._inc("paging/prefix_misses")
+        if self.monitor is not None and getattr(self.monitor, "enabled", True):
+            self.monitor.write_events([
+                ("serving/prefix_hit_tokens", float(hit_tokens),
+                 self._step())])
+
     def record_finish(self, req: Request) -> None:
         reason = FinishReason.of(req.finish_reason).value  # closed enum
         self.finished.append(req)
@@ -257,6 +283,15 @@ class ServingMetrics:
             "draft_overhead_pct": (
                 100.0 * self.draft_time / self.step_time
                 if self.step_time > 0 else None),
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": (
+                self.prefix_hits / self.prefix_lookups
+                if self.prefix_lookups else None),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_token_hit_rate": (
+                self.prefix_hit_tokens / self.prefix_lookup_tokens
+                if self.prefix_lookup_tokens else None),
             "prefill_tokens": self.prefill_tokens,
             "prefill_dispatches": self.prefill_dispatches,
             "prefill_time_s": self.prefill_time,

@@ -112,6 +112,8 @@ class Request:
     last_admit_step: int = -1               # engine step_id of last seating
 
     # telemetry counters (per-request lifecycle accounting)
+    prefix_hit_tokens: int = 0              # seed tokens skipped at seating
+    #                                         via the paged prefix cache
     chunks: int = 0                         # chunked-prefill dispatches run
     spec_drafted: int = 0                   # draft tokens proposed for this
     #                                         request's slot

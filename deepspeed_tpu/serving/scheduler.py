@@ -37,7 +37,9 @@ class FIFOScheduler:
     """Bounded FIFO admission queue with a pluggable slot-grant policy."""
 
     def __init__(self, num_slots: int, max_queue_depth: int = 64,
-                 policy: str = "continuous", capacity: Optional[int] = None):
+                 policy: str = "continuous", capacity: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None, page_headroom: int = 0):
         if policy not in POLICIES:
             raise ValueError(f"unknown policy {policy!r}; expected one of "
                              f"{POLICIES}")
@@ -45,7 +47,25 @@ class FIFOScheduler:
         self.max_queue_depth = max_queue_depth
         self.policy = policy
         self.capacity = capacity
+        # paged-KV admission accounting: with a PagedKVPool the real
+        # admission currency is PAGES, not rows — ``capacity`` alone
+        # would accept a request the page pool can never hold under
+        # oversubscription. ``page_headroom`` is extra columns charged
+        # per request (speculative verify's k-past-the-index writes).
+        self.page_size = int(page_size) if page_size is not None else None
+        self.num_pages = int(num_pages) if num_pages is not None else None
+        self.page_headroom = int(page_headroom)
         self.queue: Deque[Request] = collections.deque()
+
+    def page_footprint(self, req: Request) -> Optional[int]:
+        """Worst-case page count ``req`` could ever need (seed + its
+        remaining generation budget + headroom), or None when the pool
+        is not paged."""
+        if self.page_size is None:
+            return None
+        cols = (req.seed_len + req.max_new_tokens - len(req.output_tokens)
+                + self.page_headroom)
+        return -(-cols // self.page_size)
 
     @property
     def pending(self) -> int:
@@ -62,6 +82,14 @@ class FIFOScheduler:
                 req.seed_len + req.max_new_tokens - len(req.output_tokens) \
                 > self.capacity:
             return False, RejectReason.PROMPT_TOO_LONG
+        if self.num_pages is not None:
+            # page-denominated footprint check: even with the WHOLE pool
+            # free (every other request preempted and the prefix cache
+            # fully evicted) this request could never seat its worst
+            # case — reject now, not at an unseatable queue head
+            fp = self.page_footprint(req)
+            if fp is not None and fp > self.num_pages:
+                return False, RejectReason.PROMPT_TOO_LONG
         if len(self.queue) >= self.max_queue_depth:
             return False, RejectReason.QUEUE_FULL
         req.state = RequestState.QUEUED
@@ -106,7 +134,9 @@ class FIFOScheduler:
 
     def grant(self, free_slots: int, live_slots: int,
               token_budget: Optional[int] = None,
-              cost=None, spent: int = 0) -> List[Request]:
+              cost=None, spent: int = 0,
+              page_budget: Optional[int] = None,
+              page_cost=None) -> List[Request]:
         """Pop the requests that may take a slot this step.
 
         With ``token_budget``/``cost`` (the stall-free admission policy),
@@ -117,16 +147,33 @@ class FIFOScheduler:
         caller already committed this step (an in-flight chunk);
         liveness guard: when NOTHING has been spent or granted yet, the
         head is granted even if its cost alone exceeds the budget
-        (bounded overshoot beats a permanently stuck queue)."""
+        (bounded overshoot beats a permanently stuck queue).
+
+        With ``page_budget``/``page_cost`` (paged KV), each pop is also
+        charged ``page_cost(req)`` fresh pages (its uncached prefix).
+        The page budget is STRICT — no liveness overshoot: over-granting
+        pages doesn't slow the step down, it makes seating raise
+        PagePoolExhausted and abort the whole step. Starvation is the
+        engine's job, not an overshoot's: pressure preemption frees
+        victims' pages, and the submit-time footprint check guarantees
+        the head fits an otherwise-empty pool."""
         if self.policy == "gang" and live_slots > 0:
             return []  # batch-synchronous: wait for the whole gang to drain
         granted: List[Request] = []
         remaining = None if token_budget is None else token_budget - spent
+        pages_left = page_budget
         while self.queue and len(granted) < free_slots:
+            pc = 0
+            if pages_left is not None:
+                pc = page_cost(self.queue[0]) if page_cost is not None else 0
+                if pc > pages_left:
+                    break
             if remaining is not None:
                 c = cost(self.queue[0]) if cost is not None else 0
                 if c > remaining and (granted or spent > 0):
                     break
                 remaining -= c
+            if pages_left is not None:
+                pages_left -= pc
             granted.append(self.queue.popleft())
         return granted
