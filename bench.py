@@ -57,6 +57,16 @@ per-request lifecycle lanes + flow events) and report the tracer's
 throughput overhead vs an untraced replay; the training row traces one
 extra ``train_batch`` step.
 
+``--dump-dir <path>`` (serving-chaos): the row ends with a
+flight-recorder drill — a planted ``state_corruption`` fault followed
+by the ``check_invariants`` audit must drop EXACTLY ONE post-mortem
+JSON under ``<path>`` (a tmpdir when the flag is absent). The
+serving-stall and paging rows also report an ``efficiency`` detail
+block (MFU, goodput vs generous SLO targets, KV-HBM drift against the
+page math, telemetry ``overhead_pct``) from the runtime cost model +
+SLO tracker; ``check_regression.py --min-goodput/--max-overhead-pct``
+gate on it.
+
 ``vs_baseline`` compares achieved model TFLOPS against the reference's
 headline single-device number: 64 TFLOPS/GPU for BERT-Large pretraining
 with DeepSpeed's fused kernels on V100-32GB (BASELINE.md row 1, reference
@@ -78,6 +88,8 @@ V5E_PEAK_TFLOPS = 197.0
 
 _JSON_PATH = None   # set by __main__ from --json <path>
 _TRACE_PATH = None  # set by __main__ from --trace <path>
+_DUMP_DIR = None    # set by __main__ from --dump-dir <path>; chaos-row
+#                     post-mortem JSONs land here (tmpdir if unset)
 
 
 def _emit(result: dict) -> None:
@@ -446,6 +458,7 @@ def serving_stall_main():
         if timed:  # fresh aggregates; warmup polluted them
             srv.metrics = ServingMetrics(None, registry=srv.registry,
                                          step_fn=lambda s=srv: s.step_id)
+            srv.reset_efficiency_window()
         reqs = []
         t0 = time.perf_counter()
         i = 0
@@ -467,9 +480,17 @@ def serving_stall_main():
     # fully-compiled programs (incl. this pool's jitted multi-row admit)
     # budget = chunk + a full batch of shorts: bounds the per-step
     # prefill stall without starving free slots while a long is chunking
+    # the measured arm carries the full efficiency stack: XLA cost-model
+    # harvest (compiles land in warm_arm, where account() first sees each
+    # program), SLO digests with deliberately generous targets — this row
+    # gates that goodput is MEASURED sanely, not that a CPU box meets a
+    # production SLO — and the default flight recorder
     arm_sf = ServingEngine(engine, num_slots=slots, max_queue_depth=n_req,
                            prefill_chunk=chunk,
-                           prefill_token_budget=2 * chunk + 64 * slots)
+                           prefill_token_budget=2 * chunk + 64 * slots,
+                           cost_model=True,
+                           slo={"ttft_ms": 120_000.0, "gap_ms": 2_000.0,
+                                "window_steps": 64})
     arm_serial = ServingEngine(engine, num_slots=slots,
                                max_queue_depth=n_req, prefill_chunk=0)
     assert arm_sf._stall_free and not arm_serial._stall_free
@@ -489,6 +510,9 @@ def serving_stall_main():
     for _ in range(reps):
         sf_runs.append(run_arm(arm_sf, timed=True))
         serial_runs.append(run_arm(arm_serial, timed=True))
+    # efficiency rollup for the LAST stall-free replication (the window
+    # resets per rep); must precede the traced replay, which resets again
+    eff = arm_sf.efficiency_snapshot()
 
     decode_recompiles = engine._jit_decode._cache_size() - n_decode_programs
     recompiles = max(arm_sf.watchdog.recompiles,
@@ -552,6 +576,20 @@ def serving_stall_main():
             "recompiles_after_warmup": int(recompiles),
             "tracer": tracer_detail,
             "replications": reps,
+            "efficiency": {
+                "mfu": round(eff.get("mfu") or 0.0, 6),
+                "bandwidth_util": round(
+                    eff.get("bandwidth_util") or 0.0, 6),
+                "hbm_peak_bytes": eff.get("hbm_peak_bytes"),
+                "hbm_drift": eff.get("hbm_drift"),
+                "goodput_slo": round(eff.get("goodput_slo") or 0.0, 4),
+                "slo_ttft_p99_ms": round(eff.get("ttft_p99_ms") or 0.0, 1),
+                "slo_gap_p99_ms": round(eff.get("gap_p99_ms") or 0.0, 2),
+                "alert_state": eff.get("alert_state"),
+                "overhead_pct": round(eff.get("overhead_pct") or 0.0, 3),
+                "cost_model_unavailable":
+                    eff["costs"]["unavailable"] if "costs" in eff else None,
+            },
             "ttft_p99_ratio": round(serial["ttft_p99_ms"] /
                                     max(sf["ttft_p99_ms"], 1e-9), 3),
             "stall_free": arm_detail(sf),
@@ -758,10 +796,15 @@ def paging_main():
     budgets[1], budgets[2] = budgets[2], budgets[1]
 
     def make_srv(paged: bool) -> ServingEngine:
+        # the measured (paged) arm also carries the cost model so the row
+        # can gate page-math-predicted KV HBM == actual device bytes
         return ServingEngine(
             engine, num_slots=slots_p if paged else slots_c,
             max_queue_depth=2 * n_req, prefill_chunk=ps,
             preempt_queue_threshold=n_req // 2,
+            cost_model=paged,
+            slo={"ttft_ms": 120_000.0, "gap_ms": 2_000.0,
+                 "window_steps": 64} if paged else None,
             paged_kv={"page_size": ps, "num_pages": num_pages}
             if paged else False)
 
@@ -776,6 +819,7 @@ def paging_main():
         for _ in range(2):
             srv.submit(np.zeros((ps // 2,), np.int32), max_new_tokens=2)
             srv.run_until_drained()
+        srv.reset_efficiency_window()   # efficiency covers the timed drain
         peak_live = peak_pages = guard = 0
         t0 = time.perf_counter()
 
@@ -823,6 +867,9 @@ def paging_main():
     srv_dense = make_srv(paged=False)
     dense = run_arm(srv_dense, paged=False)
     paged = run_arm(srv_paged, paged=True)
+    # page-math-predicted KV bytes vs actual device bytes must agree
+    # EXACTLY (drift 0.0) — taken before the warm replay below
+    eff = srv_paged.efficiency_snapshot()
 
     # zero-recompile gate: warm replay of the whole workload (now ALL
     # prefix hits, including the CoW forks the duplicates force) on the
@@ -872,6 +919,19 @@ def paging_main():
             "cow_copies": pstats["cow_copies"],
             "page_evictions": pstats["page_evictions"],
             "preempted": paged["preempted"],
+            "efficiency": {
+                "mfu": round(eff.get("mfu") or 0.0, 6),
+                "hbm_peak_bytes": eff.get("hbm_peak_bytes"),
+                "hbm_drift": eff.get("hbm_drift"),
+                "kv_bytes_predicted":
+                    eff["costs"]["hbm"].get("kv_bytes_predicted")
+                    if "costs" in eff else None,
+                "kv_bytes_actual":
+                    eff["costs"]["hbm"].get("kv_bytes_actual")
+                    if "costs" in eff else None,
+                "goodput_slo": round(eff.get("goodput_slo") or 0.0, 4),
+                "overhead_pct": round(eff.get("overhead_pct") or 0.0, 3),
+            },
             "paged": {
                 "peak_resident_requests": paged["peak_live"],
                 "served_per_kv_gb": round(
@@ -1036,6 +1096,35 @@ def serving_chaos_main():
     # snapshot before the traced replay below re-fires the schedule
     faults_fired = dict(srv_chaos.faults.summary()["fired"])
 
+    # -- flight-recorder post-mortem drill ------------------------------
+    # a FRESH server (same warmed engine) with an armed state_corruption
+    # point and a dump_dir: the planted corruption breaks slot
+    # bookkeeping at the first step's tail, the check_invariants audit
+    # raises, and EXACTLY ONE self-contained post-mortem JSON must land
+    # under --dump-dir (a tmpdir when the flag is absent)
+    import glob
+    import os
+    import tempfile
+
+    dump_dir = _DUMP_DIR or tempfile.mkdtemp(prefix="dstpu-postmortem-")
+    srv_pm = make_srv(faulty=True)
+    srv_pm.dump_dir = dump_dir
+    srv_pm.recorder.dump_dir = dump_dir
+    srv_pm.faults.load_schedule({"state_corruption": [1]})
+    for p, b in zip(prompts[:slots], budgets[:slots]):
+        srv_pm.submit(p, max_new_tokens=b)
+    srv_pm.step()           # corruption fires at this step's tail
+    violation = None
+    try:
+        srv_pm.check_invariants()
+    except Exception as e:  # InvariantViolation; dumping rides the raise
+        violation = type(e).__name__
+    pm_files = sorted(os.path.basename(f) for f in glob.glob(
+        os.path.join(dump_dir, "postmortem-*.json")))
+    post_mortem = {"dir": dump_dir, "files": pm_files,
+                   "raised": violation,
+                   "exactly_one": len(pm_files) == 1}
+
     tracer_detail = None
     if _TRACE_PATH:
         from deepspeed_tpu.telemetry import Tracer
@@ -1067,6 +1156,7 @@ def serving_chaos_main():
             "fault_plan": {k: list(v) for k, v in fault_plan.items()},
             "faults_fired": faults_fired,
             "injected_aborts": chaos["injected_aborts"],
+            "post_mortem": post_mortem,
             "chaos": {
                 "completed": chaos["completed"],
                 "failed": chaos["failed"],
@@ -1097,6 +1187,8 @@ if __name__ == "__main__":
         _JSON_PATH = argv[argv.index("--json") + 1]
     if "--trace" in argv:
         _TRACE_PATH = argv[argv.index("--trace") + 1]
+    if "--dump-dir" in argv:
+        _DUMP_DIR = argv[argv.index("--dump-dir") + 1]
     if "serving-chaos" in argv:
         entry = serving_chaos_main
     elif "paging" in argv:

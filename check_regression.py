@@ -4,7 +4,8 @@
 Usage:
     python check_regression.py BASELINE.json CANDIDATE.json \
         [--metric PATH[:higher|lower]] ... [--threshold 0.10] \
-        [--max-recompiles N]
+        [--max-recompiles N] [--min-goodput FRAC] \
+        [--max-overhead-pct X] [--warn-metric PATH[:higher|lower]] ...
 
 Each ``--metric`` names a dotted path into the result object (e.g.
 ``value``, ``detail.stall_free.requests_per_s``) with an optional
@@ -43,6 +44,24 @@ must be exactly 0 and ``detail.invariants_ok`` /
 ``--max-recompiles``, these are absolute zero-tolerance checks on the
 candidate alone — a leaked slot under fault injection is a bug, not a
 regression to be thresholded.
+
+``--min-goodput FRAC`` and ``--max-overhead-pct X`` gate the
+``efficiency`` detail block the serving-stall and paging rows report
+from the runtime cost model + SLO tracker: the candidate's
+``detail.efficiency.goodput_slo`` (finished-within-SLO over admitted)
+must be >= FRAC, and ``detail.efficiency.overhead_pct`` (telemetry
+instrumentation time over accumulated step wall) must be <= X. Both
+are absolute caps on the candidate alone, like ``--max-recompiles`` —
+an unobservable server and a heavyweight observer are defects, not
+noise.
+
+``--warn-metric PATH[:higher|lower]`` runs the same relative
+comparison as ``--metric`` but never fails the gate — it prints
+``WARNING`` instead of ``REGRESSION``. Use it for metrics that are
+informative but machine-dependent, e.g. ``detail.efficiency.mfu`` on a
+CPU validation box, where XLA's cost model and the nominal peak-FLOPS
+denominator make the absolute value meaningless but a large swing is
+still worth a look.
 
 Exit codes: 0 = all metrics within threshold, 1 = at least one
 regression, 2 = unusable input (missing file, bad JSON, missing metric,
@@ -119,6 +138,22 @@ def main(argv=None) -> int:
                     help="absolute cap on the candidate's "
                          "detail.recompiles_after_warmup (no baseline, "
                          "no threshold slack)")
+    ap.add_argument("--min-goodput", type=float, default=None,
+                    metavar="FRAC",
+                    help="absolute floor on the candidate's "
+                         "detail.efficiency.goodput_slo (no baseline, "
+                         "no threshold slack)")
+    ap.add_argument("--max-overhead-pct", type=float, default=None,
+                    metavar="X",
+                    help="absolute cap on the candidate's "
+                         "detail.efficiency.overhead_pct — telemetry "
+                         "instrumentation time over step wall")
+    ap.add_argument("--warn-metric", action="append", default=[],
+                    metavar="PATH[:higher|lower]",
+                    help="like --metric but warn-only: prints WARNING "
+                         "on a beyond-threshold move, never exits 1 "
+                         "(for machine-dependent metrics like "
+                         "detail.efficiency.mfu on CPU)")
     ap.add_argument("--require-zero-leaks", action="store_true",
                     help="absolute gate on the candidate's fault-"
                          "tolerance invariants (serving-chaos row): "
@@ -156,6 +191,35 @@ def main(argv=None) -> int:
         print(f"{tag:>10}  {dotted} (absolute): candidate={r:g} "
               f"max={args.max_recompiles}")
         failed |= worse
+    if args.min_goodput is not None:
+        dotted = "detail.efficiency.goodput_slo"
+        g = _resolve(cand, dotted, args.candidate)
+        worse = g < args.min_goodput
+        tag = "REGRESSION" if worse else "ok"
+        print(f"{tag:>10}  {dotted} (absolute): candidate={g:g} "
+              f"min={args.min_goodput:g}")
+        failed |= worse
+    if args.max_overhead_pct is not None:
+        dotted = "detail.efficiency.overhead_pct"
+        o = _resolve(cand, dotted, args.candidate)
+        worse = o > args.max_overhead_pct
+        tag = "REGRESSION" if worse else "ok"
+        print(f"{tag:>10}  {dotted} (absolute): candidate={o:g} "
+              f"max={args.max_overhead_pct:g}")
+        failed |= worse
+    for spec in args.warn_metric:
+        dotted, direction = _parse_metric(spec)
+        b = _resolve(base, dotted, args.baseline)
+        c = _resolve(cand, dotted, args.candidate)
+        if b == 0:
+            delta = 0.0 if c == 0 else (1.0 if c > 0 else -1.0)
+        else:
+            delta = (c - b) / abs(b)
+        moved = delta < -args.threshold if direction == "higher" \
+            else delta > args.threshold
+        tag = "WARNING" if moved else "ok"
+        print(f"{tag:>10}  {dotted} ({direction}, warn-only): "
+              f"baseline={b:g} candidate={c:g} delta={delta:+.1%}")
     for spec in specs:
         dotted, direction = _parse_metric(spec)
         b = _resolve(base, dotted, args.baseline)

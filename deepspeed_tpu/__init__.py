@@ -162,7 +162,20 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
     ``{"num_pages": int, "page_size": int, "prefix_cache": bool}`` —
     ``num_pages`` below ``num_slots * max_seq_len / page_size``
     oversubscribes HBM; pressure is drained by trie eviction, then
-    automatic preemption."""
+    automatic preemption.
+
+    The efficiency/goodput observability keys (all server-global):
+    ``cost_model`` (``True``, a :class:`telemetry.ProgramCostModel`
+    kwargs dict, or an instance — harvests XLA ``cost_analysis()`` per
+    program and derives live MFU / bandwidth-utilization / KV-HBM-drift
+    gauges; off by default because the lazy AOT harvest compiles each
+    program once more), ``slo`` (``True``, a dict, or a
+    :class:`telemetry.SLOConfig` — windowed quantile digests, goodput
+    and burn-rate alerting), ``flight_recorder`` (on by default;
+    ``False``, an int capacity, a kwargs dict, or a
+    :class:`telemetry.FlightRecorder`), and ``dump_dir`` (where fatal
+    raises drop their post-mortem JSON; ``srv.debug_dump()`` serves the
+    same snapshot live)."""
     from .serving.engine import ServingEngine
 
     serve_keys = ("policy", "do_sample", "temperature", "top_k", "top_p",
@@ -172,7 +185,8 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
                   "deadline_default_ms", "step_wall_budget_ms",
                   "guard_numerics", "degradation",
                   "preempt_queue_threshold", "preempt_min_run_steps",
-                  "fault_injector", "paged_kv")
+                  "fault_injector", "paged_kv", "cost_model", "slo",
+                  "flight_recorder", "dump_dir")
     serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
     engine = init_inference(model=model, config=config, **kwargs)
     return ServingEngine(engine, num_slots=num_slots,

@@ -122,6 +122,10 @@ class JSONLMonitor(Monitor):
     def __init__(self, jsonl_config):
         super().__init__(jsonl_config)
         self.path: Optional[str] = None
+        # failed write_events batches (disk full, permissions, path
+        # yanked); scraped via the telemetry registry so sink failures
+        # are visible instead of silently dropping data
+        self.write_errors = 0
         if self.enabled and _is_rank_zero():
             log_dir = os.path.join(jsonl_config.output_path or "jsonl_monitor",
                                    jsonl_config.job_name)
@@ -132,10 +136,16 @@ class JSONLMonitor(Monitor):
         if self.path is None or not (self.enabled and _is_rank_zero()):
             return
         now = time.time()
-        with open(self.path, "a") as fh:
-            for name, value, step in event_list:
-                fh.write(json.dumps({"tag": name, "value": float(value),
-                                     "step": int(step), "time": now}) + "\n")
+        try:
+            with open(self.path, "a") as fh:
+                for name, value, step in event_list:
+                    fh.write(json.dumps({"tag": name, "value": float(value),
+                                         "step": int(step),
+                                         "time": now}) + "\n")
+        except OSError:
+            # a telemetry sink must never take down the serving loop;
+            # count and keep going (the gap is visible in write_errors)
+            self.write_errors += 1
 
 
 class MonitorMaster(Monitor):

@@ -84,8 +84,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..telemetry import (MetricsRegistry, RecompileWatchdog, TimelineStore,
-                         Tracer)
+from ..telemetry import (FlightRecorder, MetricsRegistry, ProgramCostModel,
+                         RecompileAfterWarmupError, RecompileWatchdog,
+                         SLOTracker, TimelineStore, Tracer)
 from ..utils.logging import log_dist
 from .metrics import ServingMetrics
 from .paged_pool import PagedKVPool, PagePoolExhausted
@@ -140,7 +141,11 @@ class ServingEngine:
                  preempt_queue_threshold: Optional[int] = None,
                  preempt_min_run_steps: int = 2,
                  fault_injector: Optional[Any] = None,
-                 paged_kv: Any = False):
+                 paged_kv: Any = False,
+                 cost_model: Any = False,
+                 slo: Any = None,
+                 flight_recorder: Any = True,
+                 dump_dir: Optional[str] = None):
         self.engine = engine
         # materialize params + jits before sizing anything off the module
         engine._ensure_params(jnp.zeros((1, 2), jnp.int32))
@@ -231,6 +236,46 @@ class ServingEngine:
             strict=strict_recompile, step_fn=lambda: self.step_id)
         self.metrics = ServingMetrics(monitor, registry=self.registry,
                                       step_fn=lambda: self.step_id)
+        # -- efficiency & goodput telemetry (ISSUE 8) ------------------
+        # cost_model: False (off), True (defaults), a ProgramCostModel
+        # kwargs dict, or an instance. Off by default: the lazy AOT
+        # harvest compiles each program once more, a warmup cost test
+        # suites constructing many servers shouldn't pay.
+        if cost_model is True:
+            cost_model = ProgramCostModel(registry=self.registry)
+        elif isinstance(cost_model, dict):
+            cost_model = ProgramCostModel(registry=self.registry,
+                                          **cost_model)
+        elif not cost_model:
+            cost_model = None
+        self.costs = cost_model
+        # _ensure_watch subscribes the cost model to every watched jit
+        self.watchdog.cost_model = self.costs
+        # slo: None/False (off), True (default SLOConfig), dict/SLOConfig
+        self.slo = (SLOTracker(slo, registry=self.registry,
+                               tracer=self.tracer, monitor=monitor)
+                    if slo else None)
+        # flight_recorder: True (defaults), int capacity, kwargs dict,
+        # an instance, or False. Default ON — one deque append per step.
+        if flight_recorder is True:
+            flight_recorder = FlightRecorder(dump_dir=dump_dir)
+        elif isinstance(flight_recorder, bool):
+            flight_recorder = None
+        elif isinstance(flight_recorder, int):
+            flight_recorder = FlightRecorder(capacity=flight_recorder,
+                                             dump_dir=dump_dir)
+        elif isinstance(flight_recorder, dict):
+            flight_recorder = FlightRecorder(
+                **{"dump_dir": dump_dir, **flight_recorder})
+        elif flight_recorder is not None and dump_dir is not None \
+                and flight_recorder.dump_dir is None:
+            flight_recorder.dump_dir = dump_dir
+        self.recorder = flight_recorder
+        self.dump_dir = dump_dir
+        self._tokens_emitted = 0        # lifetime tokens (all paths)
+        self._tokens_prev = 0           # snapshot for per-step deltas
+        self._telemetry_ns = 0          # step-boundary instrumentation
+        self.registry.add_collector(self._collect_telemetry_health)
         if self._paged:
             # pool-internal events (CoW copies, trie evictions) land in
             # the same registry as the engine-side paging/* series
@@ -347,6 +392,8 @@ class ServingEngine:
         self.tracer = tracer
         self.timelines.tracer = tracer
         self.watchdog.tracer = tracer
+        if self.slo is not None:
+            self.slo.tracer = tracer
 
     def timeline(self, request_id: int):
         """Lifecycle events recorded for one request id (oldest first),
@@ -357,6 +404,184 @@ class ServingEngine:
         """Flush the metrics registry as ``telemetry/*`` monitor events
         on the current step axis; returns the number of events."""
         return self.registry.publish(self.metrics.monitor, self.step_id)
+
+    # -- efficiency / goodput / flight recorder (ISSUE 8) --------------
+    def _collect_telemetry_health(self) -> None:
+        """Registry collector (runs at every snapshot/Prometheus
+        scrape): pull-time counters that would be wasteful to push from
+        the hot path — tracer ring totals/drops, JSONL sink write
+        errors, flight-recorder activity."""
+        g = self.registry.gauge
+        g("telemetry/tracer_events_total").set(float(self.tracer.events_total))
+        g("telemetry/tracer_dropped").set(float(self.tracer.dropped))
+        mon = self.metrics.monitor
+        jm = getattr(mon, "jsonl_monitor", None)
+        if jm is None and hasattr(mon, "write_errors"):
+            jm = mon          # a bare JSONLMonitor passed as the sink
+        if jm is not None:
+            g("monitor/jsonl_write_errors").set(
+                float(getattr(jm, "write_errors", 0)))
+        if self.recorder is not None:
+            g("telemetry/flight_recorder_records").set(
+                float(self.recorder.records_total))
+            g("telemetry/postmortem_dumps").set(
+                float(self.recorder.dump_count))
+
+    @property
+    def telemetry_overhead_s(self) -> float:
+        """Host seconds spent in the ISSUE-8 instrumentation: the
+        self-timed step-boundary block plus the cost model's per-call
+        accounting and the SLO tracker's observe/on_step work (one-time
+        AOT harvests are excluded — they are warmup, reported
+        separately in ``costs.summary()['harvest_s']``)."""
+        total = self._telemetry_ns / 1e9
+        if self.costs is not None:
+            total += self.costs.overhead_s
+        if self.slo is not None:
+            total += self.slo.overhead_s
+        return total
+
+    def _telemetry_step(self, wall: float, running_at_entry: int,
+                        granted: List[Request],
+                        finished: List[Request]) -> None:
+        """Step-boundary efficiency/SLO/flight-recorder bookkeeping,
+        self-timed so benches can report instrumentation overhead_pct
+        honestly instead of diffing noisy wall clocks."""
+        costs, slo, rec = self.costs, self.slo, self.recorder
+        if costs is None and slo is None and rec is None:
+            return
+        t0 = time.perf_counter_ns()
+        # the SLO tracker self-times its own methods; subtract its delta
+        # from this envelope so telemetry_overhead_s never double-counts
+        slo_ns0 = slo.overhead_ns if slo is not None else 0
+        tokens = self._tokens_emitted - self._tokens_prev
+        self._tokens_prev = self._tokens_emitted
+        if slo is not None:
+            if running_at_entry:
+                slo.observe_gap(wall)
+            slo.on_step(self.step_id)
+        if costs is not None:
+            costs.step_update(wall, tokens=tokens, tracer=self.tracer)
+            if self.step_id % costs.kv_every == 0:
+                costs.reconcile_kv(self.pool, monitor=self.metrics.monitor,
+                                   step=self.step_id, tracer=self.tracer)
+        if rec is not None:
+            rec.record(self._step_record(wall, granted, finished))
+        spent = time.perf_counter_ns() - t0
+        if slo is not None:
+            spent -= slo.overhead_ns - slo_ns0
+        self._telemetry_ns += spent
+
+    def _step_record(self, wall: float, granted: List[Request],
+                     finished: List[Request]) -> dict:
+        rec = {
+            "step_id": self.step_id,
+            "t_unix": time.time(),
+            "wall_ms": wall * 1e3,
+            "live": len(self._slot_req),
+            "pending": self.scheduler.pending,
+            "prefilling": len(self._prefill_queue),
+            "free_slots": self.pool.free_count,
+            "granted": [r.request_id for r in granted],
+            "finished": [r.request_id for r in finished],
+            "tokens_total": self._tokens_emitted,
+            "load_state": (self._load.state.name
+                           if self._load is not None else None),
+            "alert_state": (self.slo.alert_state
+                            if self.slo is not None else None),
+        }
+        if self._paged:
+            rec["free_pages"] = self.pool.free_page_count
+        return rec
+
+    def _post_mortem(self, reason: str, error: Any = None,
+                     extra: Optional[dict] = None) -> Optional[str]:
+        """Write a flight-recorder post-mortem dump (no-op without a
+        recorder or ``dump_dir``); never raises — the caller is already
+        unwinding the real failure."""
+        if self.recorder is None:
+            return None
+        try:
+            return self.recorder.dump(
+                reason, error=error, timelines=self.timelines,
+                registry=self.registry, tracer=self.tracer, extra=extra)
+        except Exception:       # pragma: no cover - defensive
+            return None
+
+    def debug_dump(self) -> dict:
+        """Live statusz snapshot: the flight-recorder ring, open
+        request timelines, registry, watchdog summary, every
+        non-terminal request's host state, and (when enabled) the SLO
+        and cost-model summaries — the same payload a post-mortem file
+        wraps, served from a healthy process."""
+        rec = self.recorder if self.recorder is not None \
+            else FlightRecorder(capacity=1)
+        out = rec.snapshot(timelines=self.timelines,
+                           registry=self.registry, tracer=self.tracer)
+        out.update(step_id=self.step_id, live=self.live_count,
+                   pending=self.scheduler.pending,
+                   requests=self._stuck_dump(),
+                   load_state=(self._load.state.name
+                               if self._load is not None else None),
+                   watchdog=self.watchdog.summary(),
+                   telemetry_overhead_s=self.telemetry_overhead_s)
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
+        if self.costs is not None:
+            out["costs"] = self.costs.summary()
+        return out
+
+    def efficiency_snapshot(self) -> dict:
+        """Bench-facing rollup: cost-model MFU/bandwidth, SLO goodput +
+        digest percentiles, KV HBM reconciliation, and instrumentation
+        overhead (as a fraction of accumulated step wall)."""
+        out: dict = {"telemetry_overhead_s": self.telemetry_overhead_s}
+        wall = None
+        if self.costs is not None:
+            # pull-time freshness: the loop reconciles only every
+            # kv_every steps, a snapshot should never serve stale drift
+            self.costs.reconcile_kv(self.pool, step=self.step_id)
+            cs = self.costs.summary()
+            wall = cs["wall_s"]
+            out["costs"] = cs
+            out["mfu"] = cs["mfu"]
+            out["bandwidth_util"] = cs["bandwidth_util"]
+            hbm = cs["hbm"]
+            out["hbm_drift"] = hbm.get("hbm_drift")
+            out["hbm_peak_bytes"] = hbm.get("hbm_peak_bytes")
+        if self.slo is not None:
+            ss = self.slo.snapshot()
+            out["slo"] = ss
+            out["goodput_slo"] = ss["goodput_slo"]
+            out["ttft_p99_ms"] = ss["ttft_p99_ms"]
+            out["gap_p99_ms"] = ss["gap_p99_ms"]
+            out["alert_state"] = ss["alert_state"]
+        if wall:
+            out["overhead_pct"] = 100.0 * out["telemetry_overhead_s"] / wall
+        return out
+
+    def reset_efficiency_window(self) -> None:
+        """Zero cost-model totals, SLO windows, and overhead clocks
+        (harvested program costs are kept) — benches call this after
+        warmup so efficiency numbers cover only the measured run."""
+        if self.costs is not None:
+            self.costs.reset_totals()
+        if self.slo is not None:
+            self.slo.reset()
+        self._telemetry_ns = 0
+        self._tokens_prev = self._tokens_emitted
+
+    def _chaos_corrupt_state(self) -> None:
+        """Chaos-only (the ``state_corruption`` fault point):
+        deliberately corrupt slot bookkeeping — a seated slot marked
+        free, or a free slot dropped — so the ``check_invariants``
+        audit and the flight recorder behind it are proven against REAL
+        corruption. Only reachable through an armed FaultInjector."""
+        if self._slot_req:
+            self.pool._free_set.add(min(self._slot_req))
+        elif self.pool._free_set:
+            self.pool._free_set.discard(min(self.pool._free_set))
+        self.tracer.instant("chaos/state_corruption")
 
     @property
     def live_count(self) -> int:
@@ -413,6 +638,10 @@ class ServingEngine:
             self.timelines.record(req.request_id, "rejected", terminal=True,
                                   reason=reason.value,
                                   retry_after_s=req.retry_after_s)
+        elif self.slo is not None:
+            # goodput denominator: every ADMITTED request counts against
+            # the window, whether or not it ever finishes in time
+            self.slo.observe_admitted()
         return req
 
     # ------------------------------------------------------------------
@@ -466,6 +695,7 @@ class ServingEngine:
             req.state = RequestState.RUNNING
             req.last_admit_step = self.step_id
             req.output_tokens.append(token)
+            self._tokens_emitted += 1
             self._current[slot] = token
             self.timelines.record(req.request_id, "admitted", slot=slot,
                                   mode="bucketed")
@@ -716,6 +946,7 @@ class ServingEngine:
                 req.state = RequestState.RUNNING
                 req.last_admit_step = self.step_id
                 req.output_tokens.append(token)
+                self._tokens_emitted += 1
                 self._current[slot] = token
                 self.timelines.record(req.request_id, "admitted", slot=slot,
                                       mode="batched")
@@ -791,6 +1022,7 @@ class ServingEngine:
             req.state = RequestState.RUNNING
             req.last_admit_step = self.step_id
             req.output_tokens.append(token)
+            self._tokens_emitted += 1
             self._current[slot] = token
             if first:
                 self.timelines.record(req.request_id, "first_token")
@@ -830,6 +1062,15 @@ class ServingEngine:
         (normal, length-capped, or deadline-expired): metrics, the flow
         arrow, and the terminal timeline event."""
         self.metrics.record_finish(req)
+        if self.slo is not None:
+            ok = req.finish_reason in (FinishReason.EOS, FinishReason.LENGTH,
+                                       FinishReason.LENGTH_CAP)
+            e2e = (req.finish_time - req.submit_time
+                   if req.finish_time is not None and
+                   req.submit_time is not None else None)
+            self.slo.observe_finish(ttft_s=req.ttft,
+                                    per_token_s=req.per_token_latency,
+                                    e2e_s=e2e, ok=ok)
         self.tracer.flow("f", "req", req.request_id)
         self.timelines.record(req.request_id, "finished", terminal=True,
                               reason=FinishReason.of(req.finish_reason).value,
@@ -1012,11 +1253,20 @@ class ServingEngine:
             self.registry.gauge("paging/refcounted_pages").set(float(shared))
             tracer.counter("paging/pages", free=free,
                            in_use=self.pool.num_pages - free, shared=shared)
+        if self.faults is not None and self.faults.fires("state_corruption"):
+            # chaos: corrupt our own slot bookkeeping at the boundary so
+            # check_invariants + the flight recorder face REAL damage
+            self._chaos_corrupt_state()
+        wall = self._now() - t_step
+        self._telemetry_step(wall, running_at_entry, granted, finished)
         # strict-mode recompile gate sits at the step boundary: raising
         # mid-step would trigger _abort_step and FAIL innocent in-flight
         # requests, when the state is actually perfectly consistent
-        self.watchdog.check()
-        wall = self._now() - t_step
+        try:
+            self.watchdog.check()
+        except RecompileAfterWarmupError as e:
+            self._post_mortem("recompile_after_warmup", e)
+            raise
         if self.step_wall_budget_ms is not None and \
                 wall * 1e3 > self.step_wall_budget_ms:
             # per-step wall-time watchdog: flag, don't kill — one slow
@@ -1145,6 +1395,7 @@ class ServingEngine:
             self._current[slot] = token
             emitted += 1
             self._maybe_retire(req, token, finished)
+        self._tokens_emitted += emitted
         self.metrics.record_decode_step(emitted, len(running),
                                         step_s=self._now() - t0)
 
@@ -1231,6 +1482,7 @@ class ServingEngine:
                 if req.state is not RequestState.RUNNING:
                     break
         self.pool.advance(deltas)      # per-slot KV rollback
+        self._tokens_emitted += emitted
         self.metrics.record_decode_step(emitted, len(live), drafted=drafted,
                                         accepted=accepted, draft_s=t_draft,
                                         step_s=self._now() - t0)
@@ -1300,12 +1552,15 @@ class ServingEngine:
                 still += 1
                 if still >= stall_patience:
                     dump = self._stuck_dump()
-                    raise ServingStalledError(
+                    err = ServingStalledError(
                         f"no progress for {still} consecutive steps "
                         f"(step_id={self.step_id}, pending="
                         f"{self.scheduler.pending}, live="
                         f"{self.live_count}); stuck requests: {dump}",
                         dump=dump)
+                    self._post_mortem("stalled", err,
+                                      extra={"stuck": dump})
+                    raise err
             else:
                 still = 0
                 last_sig = sig
@@ -1379,7 +1634,10 @@ class ServingEngine:
             errors.append(f"cache starts out of [0, {self.pool.capacity}]: "
                           f"{self.pool.starts.tolist()}")
         if errors:
-            raise InvariantViolation(errors)
+            err = InvariantViolation(errors)
+            self._post_mortem("invariant_violation", err,
+                              extra={"violations": errors})
+            raise err
 
     def stats(self) -> dict:
         """Aggregate SLO snapshot (see ServingMetrics.snapshot); with

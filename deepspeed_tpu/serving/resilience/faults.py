@@ -25,6 +25,12 @@ injector through its hot path at five named points:
 ``slow_dispatch``
     sleeps ``slow_ms`` inside the step — exercises the step wall-time
     watchdog and the load-state machine's latency signal.
+``state_corruption``
+    fires at the step boundary; the engine responds by deliberately
+    corrupting its own slot bookkeeping (a seated slot marked free, or
+    a free slot leaked) — exercises the ``check_invariants()`` audit
+    and the flight-recorder post-mortem path with REAL corruption, the
+    one failure class the other points are designed never to cause.
 
 A point that raises uses :class:`InjectedFault` (a ``RuntimeError``
 subclass) so harnesses can catch *injected* failures precisely while
@@ -41,7 +47,7 @@ import numpy as np
 
 #: every injection point the engine threads the injector through
 POINTS = ("admit_oom", "drafter_error", "nan_logits", "step_host_error",
-          "slow_dispatch")
+          "slow_dispatch", "state_corruption")
 
 
 class InjectedFault(RuntimeError):
@@ -132,6 +138,11 @@ class FaultInjector:
         """Raise :class:`InjectedFault` if ``point`` fires this call."""
         if self._roll(point):
             raise InjectedFault(point, self.counts[point])
+
+    def fires(self, point: str) -> bool:
+        """Non-raising roll: returns whether ``point`` fires this call.
+        For points whose effect the CALLER applies (state_corruption)."""
+        return self._roll(point)
 
     def maybe_sleep(self, point: str = "slow_dispatch") -> bool:
         """Sleep ``slow_ms`` if ``point`` fires; returns whether it did."""

@@ -23,6 +23,26 @@ DeepSpeed's observability stack, mapped feature-for-feature:
   including per-request lifecycle lanes (:class:`TimelineStore`) —
   per-iteration attribution rather than per-module FLOPs, because on
   TPU the profiler of record for intra-step FLOPs is XLA's own.
+* reference ``profiling/flops_profiler`` (module-walk MAC counting,
+  ``get_model_profile``) → :class:`ProgramCostModel`
+  (``telemetry/costs.py``). Where DeepSpeed re-derives flops from
+  module hooks, XLA already knows: every warm executable's
+  ``lowered.compile().cost_analysis()`` / ``memory_analysis()`` is
+  harvested once per abstract signature through the ``_WatchedJit``
+  seam and charged per call, yielding live MFU /
+  bandwidth-utilization / tokens-per-flop gauges plus KV-HBM
+  reconciliation (predicted page math vs actual device bytes,
+  ``telemetry/hbm_drift``).
+* no reference analogue: :class:`SLOTracker` (``telemetry/slo.py``) —
+  O(1)-memory mergeable quantile digests over sliding windows,
+  per-window goodput (finished-within-SLO ÷ admitted), and
+  multi-window burn-rate alerting (``ok``/``warn``/``page``), the
+  sensor suite the ROADMAP's SLO-aware scheduler consumes.
+* no reference analogue: :class:`FlightRecorder`
+  (``telemetry/flight_recorder.py``) — a bounded ring of per-step
+  records that becomes a self-contained post-mortem JSON when the
+  engine raises (invariant violation, stall, strict recompile), and a
+  live ``srv.debug_dump()`` statusz snapshot.
 * no reference analogue: :class:`RecompileWatchdog`. XLA recompilation
   is the TPU-specific production hazard (a shape-churned serving step
   silently costs seconds); the watchdog attributes every recompile to
@@ -51,7 +71,13 @@ from .tracer import Tracer
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .timeline import TimelineStore
 from .watchdog import (RecompileAfterWarmupError, RecompileWatchdog,
-                       abstract_signature)
+                       abstract_signature, fast_signature,
+                       suppress_compile_events)
+from .costs import (ProgramCostModel, device_memory_report,
+                    kv_hbm_report, resolve_peaks)
+from .slo import (QuantileDigest, SLOConfig, SLOTargets, SLOTracker,
+                  WindowedQuantiles)
+from .flight_recorder import FlightRecorder, POST_MORTEM_KEYS
 
 __all__ = [
     "Tracer",
@@ -63,4 +89,17 @@ __all__ = [
     "RecompileWatchdog",
     "RecompileAfterWarmupError",
     "abstract_signature",
+    "fast_signature",
+    "suppress_compile_events",
+    "ProgramCostModel",
+    "kv_hbm_report",
+    "device_memory_report",
+    "resolve_peaks",
+    "QuantileDigest",
+    "WindowedQuantiles",
+    "SLOConfig",
+    "SLOTargets",
+    "SLOTracker",
+    "FlightRecorder",
+    "POST_MORTEM_KEYS",
 ]

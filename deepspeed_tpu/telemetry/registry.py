@@ -99,6 +99,29 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
+        # zero-arg callables run before every snapshot/scrape; pull-time
+        # sources (tracer ring counters, sink error counts) register one
+        # instead of pushing on their own hot paths
+        self._collectors: List = []
+
+    def add_collector(self, fn) -> None:
+        """Register ``fn()`` to run at the top of every
+        :meth:`snapshot` / :meth:`to_prometheus`, typically to copy
+        externally-owned counters (tracer drops, sink write errors)
+        into gauges. Idempotent per callable object."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                # a broken collector must never take down a scrape
+                pass
 
     def _get(self, name: str, cls, *args):
         with self._lock:
@@ -124,6 +147,7 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, float]:
         """Flat scalar view (histograms contribute count/sum/p50/p99)."""
+        self._run_collectors()
         out: Dict[str, float] = {}
         with self._lock:
             metrics = list(self._metrics.values())
@@ -139,6 +163,7 @@ class MetricsRegistry:
 
     def to_prometheus(self) -> str:
         """Render every metric in Prometheus text exposition format."""
+        self._run_collectors()
         lines: List[str] = []
         with self._lock:
             metrics = list(self._metrics.values())

@@ -192,6 +192,11 @@ class Tracer:
     # ------------------------------------------------------------------
     # inspection / export
     # ------------------------------------------------------------------
+    @property
+    def dropped(self) -> int:
+        """Events overwritten by ring wrap-around (never exported)."""
+        return max(0, self.events_total - self.capacity)
+
     def events(self) -> List[Dict[str, Any]]:
         """Snapshot of buffered events, oldest first."""
         with self._lock:
@@ -237,7 +242,7 @@ class Tracer:
             "otherData": {
                 "epoch_unix": self.epoch_unix,
                 "events_total": self.events_total,
-                "dropped": max(0, self.events_total - self.capacity),
+                "dropped": self.dropped,
             },
         }
 

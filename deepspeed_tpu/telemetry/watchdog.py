@@ -38,6 +38,7 @@ dispatches to a ``WeakSet`` of live watchdogs.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 import weakref
@@ -89,6 +90,58 @@ def abstract_signature(args: tuple, kwargs: Dict[str, Any]) -> str:
     return "(" + ", ".join(parts) + ")"
 
 
+def _fast_one(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    if shape is not None:
+        return f"{getattr(x, 'dtype', '?')}[{','.join(map(str, shape))}]"
+    if isinstance(x, dict):
+        return f"dict{len(x)}"
+    if isinstance(x, (list, tuple)):
+        return f"{type(x).__name__}{len(x)}"
+    if isinstance(x, bool) or x is None or isinstance(x, str):
+        return repr(x)
+    if isinstance(x, (int, float)):
+        # jit traces python scalars as weak-typed values, so the *value*
+        # does not change the executable; keying on it would explode the
+        # signature space (e.g. a chunk position argument).
+        return type(x).__name__
+    return type(x).__name__
+
+
+def fast_signature(args: tuple, kwargs: Dict[str, Any]) -> str:
+    """Value-independent top-level signature, cheap enough for every
+    call: arrays by dtype/shape, containers by length, scalars by type.
+    Unlike :func:`abstract_signature` this never walks pytrees, so it
+    can key the per-call cost accounting inside the ≤3% telemetry
+    overhead budget."""
+    parts = [_fast_one(a) for a in args]
+    if kwargs:
+        parts += [f"{k}={_fast_one(v)}" for k, v in sorted(kwargs.items())]
+    return "|".join(parts)
+
+
+def _key_one(x: Any):
+    # tuple-atom twin of _fast_one: raw shape/dtype objects are hashable
+    # and skip every f-string, which matters at one key per watched call
+    shape = getattr(x, "shape", None)
+    if shape is not None:
+        return (shape, getattr(x, "dtype", None))
+    if isinstance(x, (dict, list, tuple)):
+        return (type(x).__name__, len(x))
+    if isinstance(x, (bool, str)) or x is None:
+        return x
+    return type(x).__name__
+
+
+def fast_key(args: tuple, kwargs: Dict[str, Any]) -> tuple:
+    """Hashable tuple equivalent of :func:`fast_signature` — same
+    abstraction, no string formatting; the per-call cost-accounting key."""
+    if kwargs:
+        return (tuple(map(_key_one, args)),
+                tuple((k, _key_one(v)) for k, v in sorted(kwargs.items())))
+    return tuple(map(_key_one, args))
+
+
 # ----------------------------------------------------------------------
 # per-program proxies
 # ----------------------------------------------------------------------
@@ -109,6 +162,9 @@ class _WatchedJit:
         self._name = name
         self._watchers: "weakref.WeakSet[RecompileWatchdog]" = \
             weakref.WeakSet()
+        # ProgramCostModel instances accounting flops/bytes per call
+        # (telemetry/costs.py); weak so dead servers drop off
+        self._cost_models: "weakref.WeakSet" = weakref.WeakSet()
         _ensure_listener()
 
     def __call__(self, *args, **kwargs):
@@ -118,6 +174,9 @@ class _WatchedJit:
             sig = abstract_signature(args, kwargs)
             for w in list(self._watchers):
                 w.record(self._name, sig)
+        if self._cost_models:
+            for cm in list(self._cost_models):
+                cm.account(self._name, self._fn, args, kwargs)
         return out
 
     def __getattr__(self, item):
@@ -136,11 +195,30 @@ _listener_registered = False
 # process-wide backend-compile tick; _WatchedJit snapshots it around
 # each call to attribute compiles to the program that triggered them
 _compile_events = 0
+# depth of suppress_compile_events() scopes: AOT cost harvesting
+# (telemetry/costs.py) compiles the same program out-of-band, which
+# must not register as a serving recompile
+_suppress_compiles = 0
+
+
+@contextlib.contextmanager
+def suppress_compile_events():
+    """Hide backend compiles from the watchdogs for the scope, e.g. the
+    AOT ``lower().compile()`` the cost model runs to harvest
+    ``cost_analysis()`` for an already-warm executable."""
+    global _suppress_compiles
+    _suppress_compiles += 1
+    try:
+        yield
+    finally:
+        _suppress_compiles -= 1
 
 
 def _on_event_duration(event: str, duration: float, **kw) -> None:
     global _compile_events
     if "backend_compile" in event:
+        if _suppress_compiles:
+            return
         _compile_events += 1
         for w in list(_active_watchdogs):
             w._record_backend_compile(event, duration)
@@ -170,12 +248,16 @@ class RecompileWatchdog:
     """
 
     def __init__(self, registry=None, tracer=None, monitor=None,
-                 strict: bool = False, step_fn=None, name: str = ""):
+                 strict: bool = False, step_fn=None, name: str = "",
+                 cost_model=None):
         self.registry = registry
         self.tracer = tracer
         self.monitor = monitor
         self.strict = strict
         self.name = name
+        # optional ProgramCostModel; attach() subscribes it to every
+        # proxy so per-call flops/bytes accounting rides the same seam
+        self.cost_model = cost_model
         self._step_fn = step_fn or (lambda: 0)
         self._warmed = False
         self.warmup_recompiles = 0
@@ -201,6 +283,8 @@ class RecompileWatchdog:
                 fn, name or f"{type(owner).__name__}.{attr}")
             setattr(owner, attr, proxy)
         proxy._watchers.add(self)
+        if self.cost_model is not None:
+            proxy._cost_models.add(self.cost_model)
         return proxy
 
     def attach_all(self, owner: Any, attrs) -> None:

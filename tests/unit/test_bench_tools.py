@@ -168,6 +168,67 @@ class TestCheckRegression:
         assert _run(base, cand, "--require-zero-leaks").returncode == 2
 
 
+class TestEfficiencyGates:
+    @staticmethod
+    def _eff(goodput=1.0, overhead=1.0, mfu=0.3):
+        return {"value": 1.0,
+                "detail": {"efficiency": {"goodput_slo": goodput,
+                                          "overhead_pct": overhead,
+                                          "mfu": mfu}}}
+
+    def test_min_goodput_passes_and_fails(self, tmp_path):
+        base = _write(tmp_path, "base.json", self._eff())
+        good = _write(tmp_path, "good.json", self._eff(goodput=0.97))
+        bad = _write(tmp_path, "bad.json", self._eff(goodput=0.80))
+        r = _run(base, good, "--min-goodput", "0.9")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "goodput_slo" in r.stdout
+        r = _run(base, bad, "--min-goodput", "0.9")
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout
+
+    def test_min_goodput_is_absolute(self, tmp_path):
+        # candidate better than baseline still fails below the floor
+        base = _write(tmp_path, "base.json", self._eff(goodput=0.5))
+        cand = _write(tmp_path, "cand.json", self._eff(goodput=0.7))
+        assert _run(base, cand, "--min-goodput", "0.9").returncode == 1
+
+    def test_max_overhead_pct(self, tmp_path):
+        base = _write(tmp_path, "base.json", self._eff())
+        lean = _write(tmp_path, "lean.json", self._eff(overhead=1.2))
+        fat = _write(tmp_path, "fat.json", self._eff(overhead=7.5))
+        assert _run(base, lean, "--max-overhead-pct", "3").returncode == 0
+        r = _run(base, fat, "--max-overhead-pct", "3")
+        assert r.returncode == 1
+        assert "overhead_pct" in r.stdout
+
+    def test_missing_efficiency_block_exits_2(self, tmp_path):
+        base = _write(tmp_path, "base.json", self._eff())
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        assert _run(base, cand, "--min-goodput", "0.9").returncode == 2
+
+    def test_warn_metric_never_fails(self, tmp_path):
+        # a 50% mfu drop on a CPU box: prints WARNING, exits 0
+        base = _write(tmp_path, "base.json", self._eff(mfu=0.4))
+        cand = _write(tmp_path, "cand.json", self._eff(mfu=0.2))
+        r = _run(base, cand, "--warn-metric", "detail.efficiency.mfu")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "WARNING" in r.stdout
+        # within threshold: plain ok, no warning
+        steady = _write(tmp_path, "steady.json", self._eff(mfu=0.39))
+        r = _run(base, steady, "--warn-metric", "detail.efficiency.mfu")
+        assert r.returncode == 0
+        assert "WARNING" not in r.stdout
+
+    def test_warn_metric_missing_field_still_exits_2(self, tmp_path):
+        # warn-only softens the verdict, not the plumbing: a typo'd
+        # path must stay loud
+        base = _write(tmp_path, "base.json", self._eff())
+        cand = _write(tmp_path, "cand.json", self._eff())
+        assert _run(base, cand, "--warn-metric",
+                    "detail.efficiency.mfuu").returncode == 2
+
+
 class TestBenchEntryPoints:
     def test_serving_stall_entry_wired(self):
         # arg parsing only: the row itself runs in the serving tests'
@@ -189,6 +250,11 @@ class TestBenchEntryPoints:
             < src.index('"serving-stall" in argv')
         for key in ("slot_leaks", "invariants_ok", "timelines_complete",
                     "goodput"):
+            assert key in src
+        # the flight-recorder drill: --dump-dir plumbing and the
+        # exactly-one-post-mortem report the driver gates on
+        for key in ("--dump-dir", "state_corruption", "post_mortem",
+                    "exactly_one"):
             assert key in src
 
     def test_check_regression_importable(self):
