@@ -55,6 +55,20 @@ are absolute caps on the candidate alone, like ``--max-recompiles`` —
 an unobservable server and a heavyweight observer are defects, not
 noise.
 
+``--max-lint-errors N`` gates on static trace-safety debt: it reads a
+``bin/graftlint --json`` report named by ``--lint-json FILE`` and
+requires ``summary.errors`` (unsuppressed, unbaselined graftlint
+errors) to be at most N — the serving gate runs with N=0.  Like
+``--max-recompiles`` this is an absolute cap on the candidate alone: a
+static invariant violation is a defect, not a regression to be
+thresholded.  ``--max-lint-errors`` without ``--lint-json`` is a usage
+error (exit 2)::
+
+    bin/graftlint deepspeed_tpu/serving deepspeed_tpu/telemetry \
+        --json > LINT.json
+    python check_regression.py BASE.json CAND.json \
+        --lint-json LINT.json --max-lint-errors 0
+
 ``--warn-metric PATH[:higher|lower]`` runs the same relative
 comparison as ``--metric`` but never fails the gate — it prints
 ``WARNING`` instead of ``REGRESSION``. Use it for metrics that are
@@ -154,6 +168,14 @@ def main(argv=None) -> int:
                          "on a beyond-threshold move, never exits 1 "
                          "(for machine-dependent metrics like "
                          "detail.efficiency.mfu on CPU)")
+    ap.add_argument("--lint-json", metavar="FILE", default=None,
+                    help="a `bin/graftlint --json` report to gate with "
+                         "--max-lint-errors")
+    ap.add_argument("--max-lint-errors", type=int, default=None,
+                    metavar="N",
+                    help="absolute cap on summary.errors in the "
+                         "--lint-json report (unsuppressed graftlint "
+                         "errors; the serving gate uses 0)")
     ap.add_argument("--require-zero-leaks", action="store_true",
                     help="absolute gate on the candidate's fault-"
                          "tolerance invariants (serving-chaos row): "
@@ -166,7 +188,20 @@ def main(argv=None) -> int:
     cand = _load(args.candidate)
     specs = args.metric or ["value:higher"]
 
+    if args.max_lint_errors is not None and args.lint_json is None:
+        print("check_regression: --max-lint-errors requires --lint-json "
+              "FILE (a `bin/graftlint --json` report)", file=sys.stderr)
+        sys.exit(2)
+
     failed = False
+    if args.max_lint_errors is not None:
+        lint = _load(args.lint_json)
+        e = _resolve(lint, "summary.errors", args.lint_json)
+        worse = e > args.max_lint_errors
+        tag = "REGRESSION" if worse else "ok"
+        print(f"{tag:>10}  summary.errors [graftlint] (absolute): "
+              f"candidate={e:g} max={args.max_lint_errors}")
+        failed |= worse
     if args.require_zero_leaks:
         leaks = _resolve(cand, "detail.slot_leaks", args.candidate)
         worse = leaks != 0

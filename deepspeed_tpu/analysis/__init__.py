@@ -1,0 +1,38 @@
+"""graftlint — static trace-safety analysis for the serving stack.
+
+An AST-based analyzer (stdlib :mod:`ast` only, no jax import) that
+turns the repo's hardest-won runtime invariants into CI-time rules:
+
+* ``recompile-hazard`` — value-dependent Python inside jitted code
+* ``uncommitted-buffer`` — ``jnp.zeros``-class allocations stored as
+  long-lived ``self.*`` state without a ``jax.device_put`` commit
+* ``donation-after-use`` — reads of a buffer after it was passed to a
+  ``donate_argnums`` call site
+* ``unsafe-scatter`` — dynamic-index ``.at[...].set/add`` without an
+  explicit ``mode=``
+* ``hot-loop-host-sync`` — host syncs on device values in
+  ``ServingEngine.step``-reachable code
+
+See ``bin/graftlint`` for the CLI and the "Static analysis" section of
+the README for the rule catalog, pragma syntax and baseline workflow.
+Findings are suppressed per line with::
+
+    # graftlint: allow[rule-id] -- reason
+
+This package must stay importable without jax so the CI gate runs in
+milliseconds (``bin/graftlint`` loads it standalone, bypassing the
+heavyweight ``deepspeed_tpu`` package import).
+"""
+
+from .baseline import load_baseline, write_baseline  # noqa: F401
+from .findings import ERROR, INFO, WARNING, Finding  # noqa: F401
+from .pragmas import PragmaIndex  # noqa: F401
+from .rules import ALL_RULES, META_RULES, RULES_BY_ID  # noqa: F401
+from .runner import (Report, analyze_paths, analyze_source,  # noqa: F401
+                     iter_python_files, jit_inventory)
+
+__all__ = [
+    "ALL_RULES", "META_RULES", "RULES_BY_ID", "ERROR", "WARNING", "INFO",
+    "Finding", "PragmaIndex", "Report", "analyze_paths", "analyze_source",
+    "iter_python_files", "jit_inventory", "load_baseline", "write_baseline",
+]

@@ -1,0 +1,63 @@
+"""Findings baseline: grandfathered debt that should not fail the gate.
+
+A baseline file is a JSON document of finding fingerprints (see
+:func:`~.findings.assign_fingerprints` — keyed on rule + file +
+function + normalised source text, *not* line numbers, so unrelated
+edits don't invalidate it).  The workflow::
+
+    bin/graftlint pkg/ --write-baseline graftlint_baseline.json  # freeze
+    bin/graftlint pkg/ --baseline graftlint_baseline.json        # gate
+
+Baselined findings are still printed (tagged ``[baselined]``) but do
+not count toward the error total.  Fixing the underlying code makes the
+stale entry harmless; ``--write-baseline`` regenerates a minimal file.
+The serving/telemetry gate ships with *no* baseline — it holds at zero
+outright — but the mechanism is what lets the gate extend to older
+packages without a flag day.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Set
+
+from .findings import Finding
+
+VERSION = 1
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "findings" not in doc:
+        raise ValueError(f"{path}: not a graftlint baseline file")
+    return {entry["fingerprint"] for entry in doc["findings"]
+            if isinstance(entry, dict) and "fingerprint" in entry}
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> int:
+    entries: List[dict] = []
+    for f in sorted(findings, key=lambda x: x.sort_key()):
+        if f.suppressed:
+            continue
+        entries.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "message": f.message,
+        })
+    with open(path, "w") as fh:
+        json.dump({"version": VERSION, "findings": entries}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def apply_baseline(findings: Iterable[Finding], fingerprints: Set[str]) -> int:
+    n = 0
+    for f in findings:
+        if not f.suppressed and f.fingerprint in fingerprints:
+            f.baselined = True
+            n += 1
+    return n

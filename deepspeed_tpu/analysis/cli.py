@@ -1,0 +1,117 @@
+"""Command-line front end for graftlint (see ``bin/graftlint``).
+
+Exit codes mirror ``check_regression.py``: 0 = gate passes, 1 =
+unsuppressed errors above ``--max-errors``, 2 = unusable invocation
+(bad path, bad baseline file) — a typo can never pass silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .baseline import write_baseline
+from .rules import ALL_RULES, META_RULES
+from .runner import analyze_paths, jit_inventory
+
+#: the CI gate: these trees hold at zero unsuppressed errors
+DEFAULT_GATE_PATHS = ("deepspeed_tpu/serving", "deepspeed_tpu/telemetry")
+
+
+def _default_paths() -> List[str]:
+    # resolve the gate dirs relative to the repo root (parent of the
+    # package) so `bin/graftlint` works from any cwd
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cands = [os.path.join(here, p) for p in DEFAULT_GATE_PATHS]
+    return [c for c in cands if os.path.isdir(c)]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="Static trace-safety analyzer for the serving stack "
+                    "(stdlib ast only — no jax import, runs in "
+                    "milliseconds).")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: the CI "
+                         "gate — deepspeed_tpu/serving + telemetry)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout "
+                         "(schema: {version, summary, findings})")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="fingerprint file of grandfathered findings")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="write current unsuppressed findings as the new "
+                         "baseline and exit 0")
+    ap.add_argument("--select", action="append", default=[],
+                    metavar="RULE", help="run only these rule ids "
+                    "(repeatable)")
+    ap.add_argument("--ignore", action="append", default=[],
+                    metavar="RULE", help="skip these rule ids (repeatable)")
+    ap.add_argument("--max-errors", type=int, default=0, metavar="N",
+                    help="tolerated unsuppressed+unbaselined errors "
+                         "(default 0)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--inventory", action="store_true",
+                    help="print the static jit-wrapper inventory as JSON "
+                         "and exit (watchdog coverage drift check)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print suppressed/baselined findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id:22s} [{r.severity}] {r.short}")
+        for rid, desc in META_RULES.items():
+            print(f"{rid:22s} [meta]  {desc}")
+        return 0
+
+    known = {r.id for r in ALL_RULES}
+    for rid in list(args.select) + list(args.ignore):
+        if rid not in known:
+            print(f"graftlint: unknown rule id '{rid}' "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+
+    paths = args.paths or _default_paths()
+    if not paths:
+        print("graftlint: no paths given and default gate dirs not found",
+              file=sys.stderr)
+        return 2
+
+    if args.inventory:
+        try:
+            inv = jit_inventory(paths)
+        except FileNotFoundError as e:
+            print(f"graftlint: no such path: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(inv, indent=2))
+        return 0
+
+    try:
+        report = analyze_paths(paths, select=args.select or None,
+                               ignore=args.ignore or None,
+                               baseline=args.baseline)
+    except FileNotFoundError as e:
+        print(f"graftlint: no such path: {e}", file=sys.stderr)
+        return 2
+    except (OSError, ValueError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        n = write_baseline(args.write_baseline, report.findings)
+        print(f"graftlint: wrote {n} finding(s) to {args.write_baseline}")
+        return 0
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.format_human(verbose=args.verbose))
+
+    return 1 if report.errors > args.max_errors else 0

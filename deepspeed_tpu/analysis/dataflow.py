@@ -1,0 +1,520 @@
+"""AST indexing and lightweight per-function dataflow for graftlint.
+
+Everything here is plain :mod:`ast` over a single module — no imports
+of jax, no execution.  The two exported pieces are:
+
+* :class:`ModuleIndex` — finds every jit-wrapped callable in a module
+  (``jax.jit(f)``, ``@jax.jit``, ``@partial(jax.jit, ...)``, the
+  watchdog's ``_WatchedJit(f)`` re-wrap seam), resolves the wrapped
+  target back to its ``def``/``lambda``, records which attribute the
+  wrapper is bound to (``self._admit_jit = jax.jit(...)``) together
+  with its ``donate_argnums``/``static_argnums``, and transitively
+  marks helpers called from traced code as traced themselves.
+* small dataflow helpers (:func:`flatten_statements`,
+  :func:`node_path`, :func:`reads_tainted`, :func:`stmt_exprs`) used by
+  the rules for linear, source-order taint tracking inside one
+  function.
+
+The analysis is deliberately intraprocedural and order-linear: branch
+joins are approximated by source order.  That trades soundness for a
+near-zero false-positive rate on this codebase's idioms, which is what
+lets the CI gate demand *zero* unsuppressed errors.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: attribute reads that yield static (trace-time) metadata, not values
+SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "sharding",
+               "itemsize", "nbytes"}
+
+
+def node_path(node: ast.AST) -> Optional[str]:
+    """Dotted path for a ``Name``/``Attribute`` chain (``self.pool.cache``),
+    or ``None`` for anything more exotic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def flatten_statements(fn: ast.AST) -> List[ast.stmt]:
+    """All statements of ``fn`` in source order, flattening compound
+    bodies but *not* descending into nested function/class defs (those
+    are analysed on their own)."""
+    out: List[ast.stmt] = []
+
+    def rec(stmts: Sequence[ast.stmt]) -> None:
+        for s in stmts:
+            out.append(s)
+            if isinstance(s, FunctionNode + (ast.ClassDef,)):
+                continue
+            for fname in ("body", "orelse", "finalbody"):
+                v = getattr(s, fname, None)
+                if isinstance(v, list):
+                    rec([x for x in v if isinstance(x, ast.stmt)])
+            for h in getattr(s, "handlers", []) or []:
+                rec(h.body)
+
+    body = getattr(fn, "body", [])
+    if isinstance(body, ast.expr):   # lambda: body is a single expression
+        wrapper = ast.Expr(value=body)
+        ast.copy_location(wrapper, body)
+        return [wrapper]
+    rec(body)
+    return out
+
+
+def stmt_exprs(stmt: ast.stmt) -> Iterator[ast.expr]:
+    """The expressions directly owned by ``stmt`` (not those of nested
+    statements — the flattened walk visits them on their own)."""
+    for fname, value in ast.iter_fields(stmt):
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+                elif isinstance(v, ast.withitem):
+                    yield v.context_expr
+
+
+def walk_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
+    for e in stmt_exprs(stmt):
+        yield from ast.walk(e)
+
+
+def reads_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """True when ``expr`` reads the *value* of a tainted path.
+
+    Access through shape-like attributes (``x.shape``, ``x.dtype``) and
+    ``len(x)`` is static under tracing and does not count as a value
+    read — this is what keeps ``if x.shape[0]:`` and bucket arithmetic
+    out of the recompile-hazard rule.
+    """
+    if not tainted:
+        return False
+    hit = False
+
+    def rec(n: ast.AST) -> None:
+        nonlocal hit
+        if hit:
+            return
+        if isinstance(n, ast.Attribute) and n.attr in SHAPE_ATTRS:
+            return
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            return
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            p = node_path(n)
+            if p is not None and p in tainted:
+                hit = True
+                return
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+
+    rec(expr)
+    return hit
+
+
+def target_paths(target: ast.expr) -> List[str]:
+    """Paths written by an assignment target (tuple targets flattened).
+    Subscript targets report the path of the subscripted container —
+    ``cs["index"] = ...`` writes into ``cs``."""
+    out: List[str] = []
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            out.extend(target_paths(el))
+        return out
+    if isinstance(target, ast.Starred):
+        return target_paths(target.value)
+    if isinstance(target, ast.Subscript):
+        p = node_path(target.value)
+        return [p] if p else []
+    p = node_path(target)
+    return [p] if p else []
+
+
+def _const_tuple(node: Optional[ast.expr]) -> Tuple[int, ...]:
+    """Evaluate a literal int / tuple-of-int AST node, else ()."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals: List[int] = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int) \
+                    and not isinstance(el.value, bool):
+                vals.append(el.value)
+            else:
+                return ()
+        return tuple(vals)
+    return ()
+
+
+@dataclass
+class FuncInfo:
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef / Lambda
+    qualname: str
+    class_name: Optional[str] = None   # nearest enclosing class, if any
+    parent: Optional["FuncInfo"] = None
+    is_traced: bool = False
+    jit_entry: bool = False            # directly wrapped (vs transitively)
+    static_argnums: Tuple[int, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+
+    def param_names(self) -> List[str]:
+        a = getattr(self.node, "args", None)
+        if a is None:
+            return []
+        names = [p.arg for p in
+                 list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def traced_param_names(self) -> Set[str]:
+        """Parameters that carry tracers when this function runs under
+        jit: everything except ``self``/``cls`` and, for direct jit
+        entries, the ``static_argnums`` positions (numbered over the
+        *call* signature, i.e. after dropping ``self``)."""
+        a = getattr(self.node, "args", None)
+        if a is None:
+            return set()
+        pos = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        if self.class_name and pos and pos[0] in ("self", "cls"):
+            pos = pos[1:]
+        static = set(self.static_argnums) if self.jit_entry else set()
+        out = {n for i, n in enumerate(pos) if i not in static}
+        out.update(p.arg for p in a.kwonlyargs)
+        return out
+
+
+@dataclass
+class JitBinding:
+    """``<owner>.<attr> = jax.jit(target, ...)`` (or a module-level
+    ``NAME = jax.jit(...)``) — the unit of the jit inventory."""
+    attr: str
+    class_name: Optional[str]          # class whose instances carry the attr
+    lineno: int
+    target_qualname: Optional[str]
+    donate_argnums: Tuple[int, ...]
+    static_argnums: Tuple[int, ...]
+    via: str = "jax.jit"               # or "_WatchedJit"
+
+
+class ModuleIndex:
+    """Jit topology of one module: traced functions, wrapper bindings."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.functions: Dict[ast.AST, FuncInfo] = {}
+        self.bindings: List[JitBinding] = []
+        #: (class_name, attr) -> donate_argnums, for the donation rule
+        self.donating_attrs: Dict[Tuple[Optional[str], str],
+                                  Tuple[int, ...]] = {}
+        self._jit_aliases: Set[str] = {"jax.jit"}
+        self._partial_aliases: Set[str] = {"functools.partial"}
+        self._collect_imports()
+        self._collect_functions()
+        self._collect_wraps()
+        self._propagate_traced()
+
+    # ------------------------------------------------------------ build
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for al in node.names:
+                    name = al.asname or al.name
+                    if mod == "jax" and al.name == "jit":
+                        self._jit_aliases.add(name)
+                    if mod == "functools" and al.name == "partial":
+                        self._partial_aliases.add(name)
+            elif isinstance(node, ast.Import):
+                for al in node.names:
+                    if al.name == "jax" and al.asname:
+                        self._jit_aliases.add(f"{al.asname}.jit")
+
+    def _collect_functions(self) -> None:
+        index = self.functions
+
+        def visit(node: ast.AST, qual: str, cls: Optional[str],
+                  parent: Optional[FuncInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, f"{qual}{child.name}.", child.name, parent)
+                elif isinstance(child, FunctionNode):
+                    fi = FuncInfo(child, f"{qual}{child.name}", cls, parent)
+                    index[child] = fi
+                    visit(child, f"{qual}{child.name}.", cls, fi)
+                elif isinstance(child, ast.Lambda):
+                    fi = FuncInfo(child, f"{qual}<lambda>", cls, parent)
+                    index[child] = fi
+                    visit(child, f"{qual}<lambda>.", cls, fi)
+                else:
+                    visit(child, qual, cls, parent)
+
+        visit(self.tree, "", None, None)
+
+    def _is_jit_ref(self, node: ast.expr) -> bool:
+        p = node_path(node)
+        return p is not None and p in self._jit_aliases
+
+    def _is_partial_ref(self, node: ast.expr) -> bool:
+        p = node_path(node)
+        return p is not None and (p in self._partial_aliases
+                                  or p == "partial")
+
+    def _jit_call_info(self, call: ast.Call):
+        """If ``call`` is ``jax.jit(target, ...)`` or
+        ``_WatchedJit(target, ...)``, return (target_expr, donate,
+        static, via); else None."""
+        via = None
+        if self._is_jit_ref(call.func):
+            via = "jax.jit"
+        elif node_path(call.func) in ("_WatchedJit", "watchdog._WatchedJit"):
+            via = "_WatchedJit"
+        if via is None or not call.args:
+            return None
+        donate = static = ()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                donate = _const_tuple(kw.value)
+            elif kw.arg == "static_argnums":
+                static = _const_tuple(kw.value)
+        return call.args[0], donate, static, via
+
+    def _resolve_target(self, expr: ast.expr,
+                        scope: Optional[FuncInfo],
+                        cls: Optional[str]) -> Optional[FuncInfo]:
+        """Resolve the wrapped callable back to a function we indexed."""
+        if isinstance(expr, ast.Lambda):
+            return self.functions.get(expr)
+        if isinstance(expr, ast.Name):
+            # nearest enclosing function's nested defs, then module level
+            s = scope
+            while s is not None:
+                for fi in self.functions.values():
+                    if fi.parent is s and isinstance(fi.node, FunctionNode) \
+                            and fi.node.name == expr.id:
+                        return fi
+                s = s.parent
+            for fi in self.functions.values():
+                if fi.parent is None and isinstance(fi.node, FunctionNode) \
+                        and fi.node.name == expr.id:
+                    return fi
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and cls is not None:
+            for fi in self.functions.values():
+                if fi.class_name == cls and isinstance(fi.node, FunctionNode) \
+                        and fi.node.name == expr.attr:
+                    return fi
+        return None
+
+    def _collect_wraps(self) -> None:
+        # decorators: @jax.jit and @partial(jax.jit, ...)
+        for node, fi in self.functions.items():
+            for dec in getattr(node, "decorator_list", []):
+                donate = static = ()
+                hit = False
+                if self._is_jit_ref(dec):
+                    hit = True
+                elif isinstance(dec, ast.Call) and self._is_jit_ref(dec.func):
+                    hit = True
+                    for kw in dec.keywords:
+                        if kw.arg == "donate_argnums":
+                            donate = _const_tuple(kw.value)
+                        elif kw.arg == "static_argnums":
+                            static = _const_tuple(kw.value)
+                elif isinstance(dec, ast.Call) \
+                        and self._is_partial_ref(dec.func) \
+                        and dec.args and self._is_jit_ref(dec.args[0]):
+                    hit = True
+                    for kw in dec.keywords:
+                        if kw.arg == "donate_argnums":
+                            donate = _const_tuple(kw.value)
+                        elif kw.arg == "static_argnums":
+                            static = _const_tuple(kw.value)
+                if hit:
+                    fi.is_traced = fi.jit_entry = True
+                    fi.donate_argnums = donate
+                    fi.static_argnums = static
+
+        # call-form wraps, possibly bound to an attribute
+        class WrapVisitor(ast.NodeVisitor):
+            def __init__(v, outer):
+                v.outer = outer
+                v.scope: List[FuncInfo] = []
+                v.cls: List[str] = []
+
+            def visit_ClassDef(v, node):
+                v.cls.append(node.name)
+                v.generic_visit(node)
+                v.cls.pop()
+
+            def _visit_fn(v, node):
+                v.scope.append(v.outer.functions[node])
+                v.generic_visit(node)
+                v.scope.pop()
+
+            visit_FunctionDef = _visit_fn
+            visit_AsyncFunctionDef = _visit_fn
+
+            def visit_Lambda(v, node):
+                v._visit_fn(node)
+
+            def visit_Assign(v, node):
+                v._handle_assign(node.targets, node.value)
+                v.generic_visit(node)
+
+            def visit_AnnAssign(v, node):
+                if node.value is not None:
+                    v._handle_assign([node.target], node.value)
+                v.generic_visit(node)
+
+            def _handle_assign(v, targets, value):
+                # unwrap `jax.jit(...) if cond else None`-style guards
+                if isinstance(value, ast.IfExp):
+                    for arm in (value.body, value.orelse):
+                        if isinstance(arm, ast.Call) and \
+                                v.outer._jit_call_info(arm) is not None:
+                            value = arm
+                            break
+                if not isinstance(value, ast.Call):
+                    return
+                info = v.outer._jit_call_info(value)
+                if info is None:
+                    return
+                target_expr, donate, static, via = info
+                cls = v.cls[-1] if v.cls else None
+                scope = v.scope[-1] if v.scope else None
+                fi = v.outer._resolve_target(target_expr, scope, cls)
+                for t in targets:
+                    attr = None
+                    owner = None
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in ("self", "cls"):
+                        attr, owner = t.attr, cls
+                    elif isinstance(t, ast.Name):
+                        attr, owner = t.id, None
+                    if attr is None:
+                        continue
+                    v.outer.bindings.append(JitBinding(
+                        attr=attr, class_name=owner, lineno=value.lineno,
+                        target_qualname=fi.qualname if fi else None,
+                        donate_argnums=donate, static_argnums=static,
+                        via=via))
+                    if donate:
+                        v.outer.donating_attrs[(owner, attr)] = donate
+
+            def visit_Call(v, node):
+                info = v.outer._jit_call_info(node)
+                if info is not None:
+                    target_expr, donate, static, _via = info
+                    cls = v.cls[-1] if v.cls else None
+                    scope = v.scope[-1] if v.scope else None
+                    fi = v.outer._resolve_target(target_expr, scope, cls)
+                    if fi is not None:
+                        fi.is_traced = fi.jit_entry = True
+                        fi.donate_argnums = donate
+                        fi.static_argnums = static
+                v.generic_visit(node)
+
+        WrapVisitor(self).visit(self.tree)
+
+    def _propagate_traced(self) -> None:
+        """Helpers called from traced code run under the same trace:
+        follow bare-``Name`` calls, ``self.method()`` calls, and local
+        aliases (``scatter = self._scatter_cols``) transitively."""
+        by_name_module = {fi.node.name: fi for fi in self.functions.values()
+                          if fi.parent is None
+                          and isinstance(fi.node, FunctionNode)}
+        methods: Dict[Tuple[str, str], FuncInfo] = {}
+        for fi in self.functions.values():
+            if fi.class_name and isinstance(fi.node, FunctionNode):
+                methods[(fi.class_name, fi.node.name)] = fi
+
+        def callees(fi: FuncInfo) -> List[FuncInfo]:
+            out: List[FuncInfo] = []
+            aliases: Dict[str, FuncInfo] = {}
+            for n in ast.walk(fi.node):
+                if isinstance(n, ast.Assign) and \
+                        isinstance(n.value, (ast.Name, ast.Attribute)):
+                    cal = self._resolve_callee(n.value, fi, aliases,
+                                               by_name_module, methods)
+                    if cal is not None:
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                aliases[t.id] = cal
+                if isinstance(n, ast.Call):
+                    cal = self._resolve_callee(n.func, fi, aliases,
+                                               by_name_module, methods)
+                    if cal is not None:
+                        out.append(cal)
+            return out
+
+        frontier = [fi for fi in self.functions.values() if fi.is_traced]
+        while frontier:
+            fi = frontier.pop()
+            for cal in callees(fi):
+                if not cal.is_traced:
+                    cal.is_traced = True
+                    frontier.append(cal)
+
+    def _resolve_callee(self, func_expr, fi, aliases, by_name_module,
+                        methods) -> Optional[FuncInfo]:
+        if isinstance(func_expr, ast.Name):
+            if func_expr.id in aliases:
+                return aliases[func_expr.id]
+            s = fi
+            while s is not None:
+                for cand in self.functions.values():
+                    if cand.parent is s and \
+                            isinstance(cand.node, FunctionNode) and \
+                            cand.node.name == func_expr.id:
+                        return cand
+                s = s.parent
+            return by_name_module.get(func_expr.id)
+        if isinstance(func_expr, ast.Attribute) and \
+                isinstance(func_expr.value, ast.Name) and \
+                func_expr.value.id in ("self", "cls") and fi.class_name:
+            return methods.get((fi.class_name, func_expr.attr))
+        return None
+
+    # ---------------------------------------------------------- queries
+    def traced_functions(self) -> List[FuncInfo]:
+        return [fi for fi in self.functions.values() if fi.is_traced]
+
+    def host_functions(self) -> List[FuncInfo]:
+        return [fi for fi in self.functions.values()
+                if not fi.is_traced and isinstance(fi.node, FunctionNode)]
+
+    def methods_of(self, class_name: str) -> Dict[str, FuncInfo]:
+        return {fi.node.name: fi for fi in self.functions.values()
+                if fi.class_name == class_name
+                and isinstance(fi.node, FunctionNode)}
+
+    def classes_with_method(self, method: str) -> List[str]:
+        out = []
+        for fi in self.functions.values():
+            if fi.class_name and isinstance(fi.node, FunctionNode) \
+                    and fi.node.name == method and fi.class_name not in out:
+                out.append(fi.class_name)
+        return out

@@ -1,0 +1,92 @@
+"""Finding datatypes shared by the graftlint rule engine and CLI.
+
+A :class:`Finding` is one diagnostic at one source location.  Findings
+carry a stable *fingerprint* (rule, file, enclosing function, and the
+whitespace-normalised source line plus an occurrence counter) so a
+baseline file keeps matching after unrelated edits shift line numbers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass
+from typing import Dict
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+_WS = re.compile(r"\s+")
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    func: str = ""
+    suppressed: bool = False
+    suppress_reason: str = ""
+    baselined: bool = False
+    fingerprint: str = ""
+
+    @property
+    def counts_as_error(self) -> bool:
+        return (self.severity == ERROR and not self.suppressed
+                and not self.baselined)
+
+    def sort_key(self):
+        return (self.path, self.line, self.col,
+                _SEVERITY_ORDER.get(self.severity, 9), self.rule)
+
+    def format_human(self) -> str:
+        tag = {ERROR: "E", WARNING: "W", INFO: "I"}.get(self.severity, "?")
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: [{tag}:{self.rule}] {self.message}"
+        if self.func:
+            out += f"  (in {self.func})"
+        if self.suppressed:
+            out += f"  [suppressed: {self.suppress_reason or 'no reason'}]"
+        elif self.baselined:
+            out += "  [baselined]"
+        return out
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "func": self.func,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "suppressed": self.suppressed,
+            "suppress_reason": self.suppress_reason,
+            "baselined": self.baselined,
+        }
+
+
+def assign_fingerprints(findings, source_lines) -> None:
+    """Stamp stable fingerprints onto ``findings`` (all from one file).
+
+    The key deliberately excludes the line *number*: two findings of the
+    same rule on identical source text are disambiguated by an
+    occurrence index, so inserting code above a grandfathered finding
+    does not invalidate a baseline.
+    """
+    seen: Dict[str, int] = {}
+    for f in sorted(findings, key=lambda x: (x.line, x.col, x.rule)):
+        text = ""
+        if 1 <= f.line <= len(source_lines):
+            text = _WS.sub("", source_lines[f.line - 1])
+        key = f"{f.rule}|{f.path}|{f.func}|{text}"
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        digest = hashlib.sha1(f"{key}|{idx}".encode()).hexdigest()[:16]
+        f.fingerprint = digest

@@ -1,0 +1,74 @@
+"""Per-line suppression pragmas.
+
+Syntax (trailing comment on the offending line, or a comment-only line
+immediately above it)::
+
+    x = pool.at[slot].set(v)  # graftlint: allow[unsafe-scatter] -- slot is clamped upstream
+    # graftlint: allow[hot-loop-host-sync] -- the one deliberate sync per step
+    out = np.asarray(dev)
+
+Multiple rules may be listed (``allow[rule-a,rule-b]``) and ``*``
+matches every rule.  The ``-- reason`` clause is mandatory: a pragma
+without one is itself reported as a ``pragma-missing-reason`` error so
+suppressions always document *why* the invariant does not apply.
+Pragmas that never matched a finding are reported as ``unused-pragma``
+warnings so stale allowances get cleaned up.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*allow\[([A-Za-z0-9_\-*,\s]+)\]"
+    r"(?:\s*--\s*(.*\S))?\s*$")
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: Set[str]
+    reason: str
+    comment_only: bool
+    used: bool = False
+
+    def matches(self, rule: str) -> bool:
+        return "*" in self.rules or rule in self.rules
+
+
+@dataclass
+class PragmaIndex:
+    by_line: Dict[int, Pragma] = field(default_factory=dict)
+
+    @classmethod
+    def from_source(cls, source: str) -> "PragmaIndex":
+        idx = cls()
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = PRAGMA_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = (m.group(2) or "").strip()
+            comment_only = text.strip().startswith("#")
+            idx.by_line[lineno] = Pragma(lineno, rules, reason, comment_only)
+        return idx
+
+    def lookup(self, line: int, rule: str) -> Optional[Pragma]:
+        """Pragma governing a finding at ``line`` for ``rule``.
+
+        Checks the finding's own line first, then a comment-only pragma
+        on the line directly above (the multi-line-statement escape
+        hatch).
+        """
+        p = self.by_line.get(line)
+        if p is not None and p.matches(rule):
+            return p
+        above = self.by_line.get(line - 1)
+        if above is not None and above.comment_only and above.matches(rule):
+            return above
+        return None
+
+    def all_pragmas(self) -> List[Pragma]:
+        return [self.by_line[k] for k in sorted(self.by_line)]

@@ -1,0 +1,208 @@
+"""File discovery, rule execution, pragma/baseline application.
+
+:func:`analyze_paths` is the programmatic entry point (the CLI and the
+CI gate test both call it); :func:`analyze_source` runs the rules over
+an in-memory snippet (the fixture tests).  Neither imports jax — a full
+run over ``deepspeed_tpu/serving + telemetry`` is pure-stdlib and takes
+well under a second.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .baseline import apply_baseline, load_baseline
+from .dataflow import ModuleIndex
+from .findings import ERROR, WARNING, Finding, assign_fingerprints
+from .pragmas import PragmaIndex
+from .rules import ALL_RULES, ModuleContext, Rule
+
+SCHEMA_VERSION = 1
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    files: int = 0
+
+    # ------------------------------------------------------------ counts
+    @property
+    def errors(self) -> int:
+        return sum(1 for f in self.findings if f.counts_as_error)
+
+    @property
+    def warnings(self) -> int:
+        return sum(1 for f in self.findings
+                   if f.severity == WARNING and not f.suppressed
+                   and not f.baselined)
+
+    @property
+    def suppressed(self) -> int:
+        return sum(1 for f in self.findings if f.suppressed)
+
+    @property
+    def baselined(self) -> int:
+        return sum(1 for f in self.findings if f.baselined)
+
+    # ------------------------------------------------------------ output
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "version": SCHEMA_VERSION,
+            "summary": {
+                "files": self.files,
+                "total": len(self.findings),
+                "errors": self.errors,
+                "warnings": self.warnings,
+                "suppressed": self.suppressed,
+                "baselined": self.baselined,
+            },
+            "findings": [f.to_dict()
+                         for f in sorted(self.findings,
+                                         key=lambda x: x.sort_key())],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def format_human(self, verbose: bool = False) -> str:
+        lines = []
+        for f in sorted(self.findings, key=lambda x: x.sort_key()):
+            if (f.suppressed or f.baselined) and not verbose:
+                continue
+            lines.append(f.format_human())
+        lines.append(
+            f"graftlint: {len(self.findings)} finding(s) in {self.files} "
+            f"file(s) — {self.errors} error(s), {self.warnings} "
+            f"warning(s), {self.suppressed} suppressed, "
+            f"{self.baselined} baselined")
+        return "\n".join(lines)
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(root, fn))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def _relpath(path: str) -> str:
+    try:
+        rel = os.path.relpath(path)
+    except ValueError:
+        return path
+    return path if rel.startswith("..") else rel
+
+
+def analyze_source(source: str, path: str = "<memory>",
+                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run rules + pragma handling over one in-memory module."""
+    rules = list(rules) if rules is not None else list(ALL_RULES)
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        findings.append(Finding(
+            rule="parse-error", severity=ERROR, path=path,
+            line=e.lineno or 1, col=(e.offset or 0) + 1,
+            message=f"file does not parse: {e.msg}"))
+        assign_fingerprints(findings, source.splitlines())
+        return findings
+
+    index = ModuleIndex(tree)
+    ctx = ModuleContext(path, source, tree, index)
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+
+    pragmas = PragmaIndex.from_source(source)
+    for f in findings:
+        p = pragmas.lookup(f.line, f.rule)
+        if p is not None:
+            p.used = True
+            if p.reason:
+                f.suppressed = True
+                f.suppress_reason = p.reason
+            # a reasonless pragma does NOT suppress: the finding stays
+            # an error and the pragma itself is flagged below
+    for p in pragmas.all_pragmas():
+        if not p.reason:
+            findings.append(Finding(
+                rule="pragma-missing-reason", severity=ERROR, path=path,
+                line=p.line, col=1,
+                message="graftlint pragma without `-- reason`: every "
+                        "suppression must say why the invariant does "
+                        "not apply here"))
+        elif not p.used:
+            findings.append(Finding(
+                rule="unused-pragma", severity=WARNING, path=path,
+                line=p.line, col=1,
+                message=f"pragma allow[{','.join(sorted(p.rules))}] "
+                        "matched no finding — stale allowance, remove it"))
+    assign_fingerprints(findings, source.splitlines())
+    return findings
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Iterable[str]] = None,
+                  ignore: Optional[Iterable[str]] = None,
+                  baseline: Optional[str] = None) -> Report:
+    rules: List[Rule] = list(ALL_RULES)
+    if select:
+        chosen = set(select)
+        rules = [r for r in rules if r.id in chosen]
+    if ignore:
+        dropped = set(ignore)
+        rules = [r for r in rules if r.id not in dropped]
+
+    report = Report()
+    for fp in iter_python_files(paths):
+        with open(fp, encoding="utf-8") as fh:
+            source = fh.read()
+        report.files += 1
+        report.findings.extend(
+            analyze_source(source, _relpath(fp), rules))
+
+    if baseline:
+        apply_baseline(report.findings, load_baseline(baseline))
+    return report
+
+
+def jit_inventory(paths: Sequence[str]) -> List[Dict[str, object]]:
+    """Statically enumerate every jit-wrapper binding (``self.attr =
+    jax.jit(...)`` / module-level ``NAME = jax.jit(...)``) under
+    ``paths`` — the input to the watchdog-coverage drift test."""
+    out: List[Dict[str, object]] = []
+    for fp in iter_python_files(paths):
+        with open(fp, encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=fp)
+        except SyntaxError:
+            continue
+        index = ModuleIndex(tree)
+        for b in index.bindings:
+            out.append({
+                "path": _relpath(fp),
+                "line": b.lineno,
+                "class": b.class_name,
+                "attr": b.attr,
+                "target": b.target_qualname,
+                "donate_argnums": list(b.donate_argnums),
+                "static_argnums": list(b.static_argnums),
+                "via": b.via,
+            })
+    return out

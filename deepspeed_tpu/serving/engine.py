@@ -107,6 +107,11 @@ _WATCHED_POOL_JITS = ("_admit_jit", "_admit_rows_jit",
                       "_paged_decode_jit", "_paged_verify_jit",
                       "_paged_chunk_jit", "_jit_copy_page")
 _WATCHED_SERVING_JITS = ("_jit_finite",)
+# the model drafter jits its own last-token argmax (lazily, on the
+# first propose); unwatched it was the one serving-side jit that could
+# recompile post-warmup without attribution — found by the graftlint
+# jit inventory, pinned by tests/unit/analysis/test_inventory.py
+_WATCHED_DRAFTER_JITS = ("_argmax",)
 
 _MIN_PREFILL_BUCKET = 16
 
@@ -379,6 +384,12 @@ class ServingEngine:
             wd.attach(self.pool, attr, name=f"SlotPool.{attr}")
         for attr in _WATCHED_SERVING_JITS:
             wd.attach(self, attr, name=f"ServingEngine.{attr}")
+        if self._drafter is not None:
+            # unwrap the fault-injection shim; the jit lives on the
+            # real drafter
+            drafter = getattr(self._drafter, "inner", self._drafter)
+            for attr in _WATCHED_DRAFTER_JITS:
+                wd.attach(drafter, attr, name=f"Drafter.{attr}")
 
     def end_warmup(self) -> None:
         """Declare warmup traffic over: from here on, any recompile counts
@@ -647,6 +658,7 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def _sample(self, logits) -> np.ndarray:
         self._rng, sub = jax.random.split(self._rng)
+        # graftlint: allow[hot-loop-host-sync] -- the sampler IS the step's one deliberate sync: tokens must reach the host to extend requests
         return np.asarray(self.engine._jit_sample(
             logits, sub, jnp.asarray(self.temperature, jnp.float32),
             int(self.top_k), float(self.top_p), self._greedy))
@@ -1346,6 +1358,7 @@ class ServingEngine:
         ``guard_numerics`` is on."""
         if self._jit_finite is None or not running:
             return running
+        # graftlint: allow[hot-loop-host-sync] -- tiny (B,) bool pulled only when guard_numerics is armed; failing slots must be retired on host
         finite = np.asarray(self._jit_finite(logits))
         ok = [(slot, req) for slot, req in running if bool(finite[slot])]
         for slot, req in running:
@@ -1456,8 +1469,9 @@ class ServingEngine:
                 self.pool.cache = cache
         with self.tracer.span("serving/sample"):
             # host sync: accepted tokens exist
+            # graftlint: allow[hot-loop-host-sync] -- the verify sync is spec decode's one deliberate hop: accepted tokens extend requests on host
             out = np.asarray(out)       # (B, K+1) emitted tokens per row
-            n_emit = np.asarray(n_emit)  # (B,) accepted drafts + 1
+            n_emit = np.asarray(n_emit)  # graftlint: allow[hot-loop-host-sync] -- same deliberate verify sync, (B,) accept counts
 
         deltas = np.zeros((B,), np.int32)
         emitted = drafted = accepted = 0

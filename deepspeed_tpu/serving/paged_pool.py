@@ -474,7 +474,7 @@ class PagedKVPool(SlotPool):
                 jnp.arange(C, dtype=jnp.int32)[None, :]       # (1, C)
             outcs = scatter(cs, new, row_table[None], W)
             outcs["index"] = cs["index"].at[slot].set(
-                start + jnp.asarray(length, jnp.int32))
+                start + jnp.asarray(length, jnp.int32), mode="drop")
             outcs["table"] = cs["table"]
             return out, outcs
 

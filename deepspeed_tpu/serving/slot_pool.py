@@ -158,7 +158,7 @@ class SlotPool:
 
         out = {k: write(pool[k], pre[k]) for k in pool if k != "index"}
         out["index"] = pool["index"].at[jnp.asarray(slot, jnp.int32)].set(
-            jnp.asarray(length, jnp.int32))
+            jnp.asarray(length, jnp.int32), mode="drop")
         return out
 
     @staticmethod

@@ -1,0 +1,81 @@
+"""The CI gate: graftlint over serving/ + telemetry/ must report zero
+unsuppressed errors, and every suppression must carry a reason.  Pure
+AST analysis — no tracing, runs in well under a second — so this sits
+in tier-1 and fails the suite the moment a trace-safety invariant is
+broken on paper, before any jit runs."""
+
+import json
+import os
+import subprocess
+import sys
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis import ALL_RULES, analyze_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    deepspeed_tpu.__file__)))
+GATE_PATHS = [os.path.join(REPO, "deepspeed_tpu", "serving"),
+              os.path.join(REPO, "deepspeed_tpu", "telemetry")]
+
+
+def test_gate_zero_unsuppressed_errors():
+    rep = analyze_paths(GATE_PATHS)
+    offenders = [f.format_human() for f in rep.findings
+                 if f.counts_as_error]
+    assert rep.errors == 0, (
+        "graftlint gate broken — fix the finding or add a reasoned "
+        "pragma:\n" + "\n".join(offenders))
+    assert rep.warnings == 0, [f.format_human() for f in rep.findings
+                               if f.severity == "warning"]
+
+
+def test_gate_every_suppression_carries_a_reason():
+    rep = analyze_paths(GATE_PATHS)
+    assert rep.suppressed > 0, (
+        "expected the documented deliberate host syncs to be pragma'd")
+    for f in rep.findings:
+        if f.suppressed:
+            assert f.suppress_reason, f.format_human()
+
+
+def test_gate_runs_every_rule():
+    # the gate must not silently run with a subset of the catalog
+    assert {r.id for r in ALL_RULES} == {
+        "recompile-hazard", "uncommitted-buffer", "donation-after-use",
+        "unsafe-scatter", "hot-loop-host-sync"}
+
+
+def test_cli_json_schema_and_exit_code():
+    """`bin/graftlint --json` is the standalone gate: exit 0 and a
+    stable {version, summary, findings} document."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "graftlint"),
+         "--json"] + GATE_PATHS,
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 1
+    summary = doc["summary"]
+    assert summary["errors"] == 0
+    assert {"files", "total", "errors", "warnings", "suppressed",
+            "baselined"} <= set(summary)
+    for f in doc["findings"]:
+        assert {"rule", "severity", "path", "line", "message",
+                "fingerprint"} <= set(f)
+
+
+def test_cli_fails_on_seeded_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(pool, slot, v):\n"
+                   "    return pool.at[slot].set(v)\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "graftlint"), str(bad)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "unsafe-scatter" in proc.stdout
+    # bad path -> usage error, distinct from gate failure
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "graftlint"),
+         str(tmp_path / "missing.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc2.returncode == 2

@@ -1,0 +1,126 @@
+"""Suppression pragmas and the findings baseline: reasoned pragmas
+suppress, reasonless ones are themselves errors, stale ones warn, and
+baseline fingerprints survive line drift but not source edits."""
+
+import json
+
+from deepspeed_tpu.analysis import (analyze_paths, analyze_source,
+                                    load_baseline, write_baseline)
+
+_SCATTER = (
+    "def admit(pool, slot, v):\n"
+    "    return pool.at[slot].set(v)"
+)
+
+
+def test_pragma_with_reason_suppresses():
+    src = _SCATTER + "  # graftlint: allow[unsafe-scatter] -- slot is clamped upstream\n"
+    (f,) = [x for x in analyze_source(src) if x.rule == "unsafe-scatter"]
+    assert f.suppressed and f.suppress_reason == "slot is clamped upstream"
+    assert not f.counts_as_error
+
+
+def test_pragma_on_comment_line_above_suppresses():
+    src = (
+        "def admit(pool, slot, v):\n"
+        "    # graftlint: allow[unsafe-scatter] -- covers the next line\n"
+        "    return pool.at[slot].set(v)\n")
+    (f,) = [x for x in analyze_source(src) if x.rule == "unsafe-scatter"]
+    assert f.suppressed
+
+
+def test_pragma_wildcard_and_multi_rule():
+    src = _SCATTER + "  # graftlint: allow[*] -- fixture\n"
+    (f,) = [x for x in analyze_source(src) if x.rule == "unsafe-scatter"]
+    assert f.suppressed
+    src2 = _SCATTER + "  # graftlint: allow[unsafe-scatter,recompile-hazard] -- fixture\n"
+    findings = analyze_source(src2)
+    assert [x for x in findings if x.rule == "unsafe-scatter"][0].suppressed
+    # the recompile-hazard half matched nothing, but the pragma as a
+    # whole was used — no stale warning
+    assert not [x for x in findings if x.rule == "unused-pragma"]
+
+
+def test_pragma_without_reason_is_an_error_and_does_not_suppress():
+    src = _SCATTER + "  # graftlint: allow[unsafe-scatter]\n"
+    findings = analyze_source(src)
+    scatter = [x for x in findings if x.rule == "unsafe-scatter"][0]
+    assert not scatter.suppressed and scatter.counts_as_error
+    missing = [x for x in findings if x.rule == "pragma-missing-reason"]
+    assert len(missing) == 1 and missing[0].severity == "error"
+
+
+def test_pragma_wrong_rule_does_not_suppress():
+    src = _SCATTER + "  # graftlint: allow[recompile-hazard] -- wrong rule\n"
+    findings = analyze_source(src)
+    assert [x for x in findings
+            if x.rule == "unsafe-scatter"][0].counts_as_error
+    assert [x for x in findings if x.rule == "unused-pragma"]
+
+
+def test_unused_pragma_warns():
+    src = "x = 1  # graftlint: allow[unsafe-scatter] -- nothing here\n"
+    (f,) = analyze_source(src)
+    assert f.rule == "unused-pragma" and f.severity == "warning"
+
+
+# ------------------------------------------------------------- baseline
+def _write_module(tmp_path, body):
+    p = tmp_path / "mod.py"
+    p.write_text(body)
+    return str(p)
+
+
+def test_baseline_round_trip(tmp_path):
+    mod = _write_module(tmp_path, _SCATTER + "\n")
+    bl = str(tmp_path / "baseline.json")
+
+    rep = analyze_paths([mod])
+    assert rep.errors == 1
+    n = write_baseline(bl, rep.findings)
+    assert n == 1
+    assert len(load_baseline(bl)) == 1
+
+    rep2 = analyze_paths([mod], baseline=bl)
+    assert rep2.errors == 0 and rep2.baselined == 1
+    doc = rep2.to_dict()
+    assert doc["summary"]["baselined"] == 1
+    assert doc["summary"]["errors"] == 0
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    mod = _write_module(tmp_path, _SCATTER + "\n")
+    bl = str(tmp_path / "baseline.json")
+    write_baseline(bl, analyze_paths([mod]).findings)
+
+    # prepend unrelated code: the finding moves down two lines but its
+    # fingerprint (rule + file + function + normalised text) holds
+    _write_module(tmp_path, "import math\nK = 3\n" + _SCATTER + "\n")
+    rep = analyze_paths([mod], baseline=bl)
+    assert rep.errors == 0 and rep.baselined == 1
+
+
+def test_baseline_invalidated_by_source_edit(tmp_path):
+    mod = _write_module(tmp_path, _SCATTER + "\n")
+    bl = str(tmp_path / "baseline.json")
+    write_baseline(bl, analyze_paths([mod]).findings)
+
+    # the flagged line itself changes -> the grandfathered entry no
+    # longer matches and the finding comes back as a live error
+    _write_module(
+        tmp_path,
+        "def admit(pool, slot, v):\n"
+        "    return pool.at[slot].add(v)\n")
+    rep = analyze_paths([mod], baseline=bl)
+    assert rep.errors == 1 and rep.baselined == 0
+
+
+def test_baseline_rejects_foreign_json(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"not": "a baseline"}))
+    try:
+        load_baseline(str(bad))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("expected ValueError on foreign JSON")

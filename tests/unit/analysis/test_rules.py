@@ -1,0 +1,307 @@
+"""Per-rule fixtures: each graftlint rule must FIRE on its seeded
+violation and stay SILENT on the paired safe idiom.  The negative
+fixtures pin the known false-positive shapes from the real codebase
+(``jnp.zeros`` handed straight to ``device_put``, ``.at[].set`` with a
+static index, donation killed by same-statement rebinding, helper calls
+acting as host barriers) so FP regressions break loudly here instead of
+breaking the serving gate."""
+
+from deepspeed_tpu.analysis import analyze_source
+
+
+def _errors(src, rule=None):
+    out = [f for f in analyze_source(src) if f.severity == "error"
+           and not f.suppressed]
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+# ------------------------------------------------------ recompile-hazard
+def test_recompile_item_in_jitted_fn_fires():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+        "g = jax.jit(f)\n")
+    (f,) = _errors(src, "recompile-hazard")
+    assert f.line == 3 and ".item()" in f.message
+
+
+def test_recompile_branch_on_traced_fires_each_form():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, y):\n"
+        "    if x:\n"
+        "        return y\n"
+        "    while not y:\n"
+        "        pass\n"
+        "    return int(x)\n")
+    rules = {(f.line, f.rule) for f in _errors(src, "recompile-hazard")}
+    assert (4, "recompile-hazard") in rules
+    assert (6, "recompile-hazard") in rules
+    assert (8, "recompile-hazard") in rules
+
+
+def test_recompile_range_len_fires():
+    src = (
+        "import jax, functools\n"
+        "@functools.partial(jax.jit, static_argnums=(1,))\n"
+        "def f(x, n):\n"
+        "    for i in range(len(x)):\n"
+        "        pass\n"
+        "    return x\n")
+    (f,) = _errors(src, "recompile-hazard")
+    assert f.line == 4
+
+
+def test_recompile_static_argnums_and_shape_access_silent():
+    # n is static (per static_argnums) and shape access is trace-time
+    src = (
+        "import jax, functools\n"
+        "import jax.numpy as jnp\n"
+        "@functools.partial(jax.jit, static_argnums=(1,))\n"
+        "def f(x, n):\n"
+        "    if n:\n"
+        "        x = x + 1\n"
+        "    if x.shape[0] > 2:\n"
+        "        x = x * 2\n"
+        "    return jnp.zeros(x.shape)\n")
+    assert _errors(src) == []
+
+
+def test_recompile_membership_and_compare_silent():
+    # `if key not in cs` / comparisons over traced dicts are static —
+    # the paged pool's _copy_page_body idiom
+    src = (
+        "import jax\n"
+        "def body(cs, slot):\n"
+        "    out = {}\n"
+        "    for key in cs:\n"
+        "        if key != 'index':\n"
+        "            out[key] = cs[key]\n"
+        "    return out\n"
+        "wrapped = jax.jit(body, donate_argnums=(0,))\n")
+    assert _errors(src) == []
+
+
+def test_recompile_transitive_helper_and_self_method():
+    # helpers called from jitted code run under the same trace — the
+    # `scatter = self._scatter_cols` aliasing idiom
+    src = (
+        "import jax\n"
+        "class P:\n"
+        "    def bind(self):\n"
+        "        def body(cs, w):\n"
+        "            helper = self._helper\n"
+        "            return helper(cs, w)\n"
+        "        self._jit = jax.jit(body, donate_argnums=(0,))\n"
+        "    def _helper(self, cs, w):\n"
+        "        return bool(w)\n")
+    (f,) = _errors(src, "recompile-hazard")
+    assert f.func == "P._helper"
+
+
+# ---------------------------------------------------- uncommitted-buffer
+def test_uncommitted_self_assign_fires():
+    src = (
+        "import jax.numpy as jnp\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self.cache = jnp.zeros((4, 4))\n")
+    (f,) = _errors(src, "uncommitted-buffer")
+    assert f.line == 4 and "self.cache" in f.message
+
+
+def test_uncommitted_via_local_var_fires():
+    src = (
+        "import jax.numpy as jnp\n"
+        "class Pool:\n"
+        "    def build(self):\n"
+        "        buf = jnp.full((8,), 0)\n"
+        "        self.table = buf\n")
+    (f,) = _errors(src, "uncommitted-buffer")
+    assert f.line == 5
+
+
+def test_uncommitted_device_put_silent():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "class Pool:\n"
+        "    def __init__(self, s):\n"
+        "        self.cache = jax.device_put(jnp.zeros((4, 4)), s)\n"
+        "        buf = jnp.ones((8,))\n"
+        "        buf = jax.device_put(buf, s)\n"
+        "        self.table = buf\n")
+    assert _errors(src) == []
+
+
+def test_uncommitted_local_only_and_inside_jit_silent():
+    # a returned local (the _fresh_cache idiom) and allocations inside
+    # a jitted function are both fine
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "class Pool:\n"
+        "    def _fresh(self):\n"
+        "        cs = {}\n"
+        "        cs['index'] = jnp.zeros((4,), jnp.int32)\n"
+        "        return cs\n"
+        "    def _body(self, pool):\n"
+        "        return jnp.zeros_like(pool)\n"
+        "    def bind(self):\n"
+        "        self._jit = jax.jit(self._body)\n")
+    assert _errors(src) == []
+
+
+# ---------------------------------------------------- donation-after-use
+def test_donation_read_after_donating_call_fires():
+    src = (
+        "import jax\n"
+        "class Pool:\n"
+        "    def bind(self):\n"
+        "        self._admit_jit = jax.jit(self._admit, donate_argnums=(0,))\n"
+        "    def _admit(self, pool, pre):\n"
+        "        return pool\n"
+        "    def admit(self, pre):\n"
+        "        out = self._admit_jit(self.cache, pre)\n"
+        "        return self.cache['index']\n")
+    (f,) = _errors(src, "donation-after-use")
+    assert f.line == 9 and "self.cache" in f.message
+
+
+def test_donation_same_statement_rebind_silent():
+    # the engine idiom: the donated buffer is rebound from the call's
+    # result in the same (or next) statement
+    src = (
+        "import jax\n"
+        "class Pool:\n"
+        "    def bind(self):\n"
+        "        self._admit_jit = jax.jit(self._admit, donate_argnums=(0,))\n"
+        "    def _admit(self, pool, pre):\n"
+        "        return pool\n"
+        "    def admit(self, pre):\n"
+        "        self.cache = self._admit_jit(self.cache, pre)\n"
+        "        return self.cache['index']\n")
+    assert _errors(src) == []
+
+
+def test_donation_fallback_map_cross_module():
+    # call sites of wrappers defined in ANOTHER module gate through the
+    # name-keyed fallback map (the engine calling _jit_decode)
+    bad = (
+        "class S:\n"
+        "    def step(self, eng, tokens, pos):\n"
+        "        logits, cache = eng._jit_decode(eng.params,\n"
+        "                                        self.pool.cache, tokens,\n"
+        "                                        pos)\n"
+        "        stale = self.pool.cache['cache_store']\n"
+        "        self.pool.cache = cache\n"
+        "        return logits, stale\n")
+    (f,) = _errors(bad, "donation-after-use")
+    assert f.line == 6
+    good = bad.replace("        stale = self.pool.cache['cache_store']\n",
+                       "")
+    assert _errors(good) == []
+
+
+# -------------------------------------------------------- unsafe-scatter
+def test_scatter_dynamic_index_without_mode_fires():
+    src = (
+        "def admit(pool, slot, length):\n"
+        "    return pool.at[slot].set(length)\n")
+    (f,) = _errors(src, "unsafe-scatter")
+    assert f.line == 2 and "mode=" in f.message
+
+
+def test_scatter_add_dynamic_fires():
+    src = (
+        "def bump(refs, pages):\n"
+        "    return refs.at[pages].add(1)\n")
+    assert len(_errors(src, "unsafe-scatter")) == 1
+
+
+def test_scatter_explicit_mode_silent():
+    src = (
+        "def admit(pool, slot, length):\n"
+        "    return pool.at[slot].set(length, mode='drop')\n")
+    assert _errors(src) == []
+
+
+def test_scatter_static_index_silent():
+    src = (
+        "def seed(pool, v):\n"
+        "    a = pool.at[0].set(v)\n"
+        "    b = pool.at[:, 2].set(v)\n"
+        "    c = pool.at[-1].set(v)\n"
+        "    return a, b, c\n")
+    assert _errors(src) == []
+
+
+# ---------------------------------------------------- hot-loop-host-sync
+_HOT_PREAMBLE = (
+    "import numpy as np\n"
+    "import jax.numpy as jnp\n")
+
+
+def test_hot_loop_sync_in_step_fires():
+    src = _HOT_PREAMBLE + (
+        "class Srv:\n"
+        "    def step(self):\n"
+        "        logits = self._jit_decode(self.params)\n"
+        "        return float(logits)\n")
+    (f,) = _errors(src, "hot-loop-host-sync")
+    assert f.line == 6 and "float" in f.message
+
+
+def test_hot_loop_sync_in_step_reachable_helper_fires():
+    src = _HOT_PREAMBLE + (
+        "class Srv:\n"
+        "    def step(self):\n"
+        "        return self._decode()\n"
+        "    def _decode(self):\n"
+        "        logits = self.pool.run_decode(1)\n"
+        "        return np.asarray(logits)\n")
+    (f,) = _errors(src, "hot-loop-host-sync")
+    assert f.func == "Srv._decode"
+
+
+def test_hot_loop_unreachable_method_silent():
+    # same sync, but not reachable from step() — warmup/debug paths are
+    # free to sync
+    src = _HOT_PREAMBLE + (
+        "class Srv:\n"
+        "    def step(self):\n"
+        "        return None\n"
+        "    def warmup(self):\n"
+        "        logits = self.pool.run_decode(1)\n"
+        "        return np.asarray(logits)\n")
+    assert _errors(src) == []
+
+
+def test_hot_loop_host_data_and_helper_barrier_silent():
+    # np over host data is fine, and a helper call is a host barrier:
+    # its internal sync is charged once, not again at every caller
+    src = _HOT_PREAMBLE + (
+        "class Srv:\n"
+        "    def step(self):\n"
+        "        gaps = [1.0, 2.0]\n"
+        "        p95 = float(np.percentile(np.asarray(gaps), 95))\n"
+        "        logits = self._jit_decode(self.params)\n"
+        "        tokens = self._sample(logits)\n"
+        "        return int(tokens[0]) + p95\n")
+    assert _errors(src) == []
+
+
+def test_hot_loop_sink_result_is_host():
+    # the np.asarray itself fires once; the host copy it returns is
+    # then free to use
+    src = _HOT_PREAMBLE + (
+        "class Srv:\n"
+        "    def step(self):\n"
+        "        finite = np.asarray(self._jit_finite(self.logits))\n"
+        "        return bool(finite[0])\n")
+    errs = _errors(src, "hot-loop-host-sync")
+    assert [f.line for f in errs] == [5]

@@ -124,6 +124,46 @@ class TestCheckRegression:
         assert "recompiles_after_warmup" in r.stderr
 
     @staticmethod
+    def _lint(errors=0):
+        # shape of a `bin/graftlint --json` report
+        return {"version": 1,
+                "summary": {"files": 25, "total": errors, "errors": errors,
+                            "warnings": 0, "suppressed": 4, "baselined": 0},
+                "findings": []}
+
+    def test_max_lint_errors_within_cap_passes(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 100.0})
+        cand = _write(tmp_path, "cand.json", {"value": 100.0})
+        lint = _write(tmp_path, "lint.json", self._lint(errors=0))
+        r = _run(base, cand, "--lint-json", lint, "--max-lint-errors", "0")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "graftlint" in r.stdout
+
+    def test_max_lint_errors_over_cap_fails(self, tmp_path):
+        # absolute gate: static debt fails even when metrics improve
+        base = _write(tmp_path, "base.json", {"value": 100.0})
+        cand = _write(tmp_path, "cand.json", {"value": 200.0})
+        lint = _write(tmp_path, "lint.json", self._lint(errors=3))
+        r = _run(base, cand, "--lint-json", lint, "--max-lint-errors", "2")
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout
+
+    def test_max_lint_errors_without_lint_json_exits_2(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 1.0})
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        r = _run(base, cand, "--max-lint-errors", "0")
+        assert r.returncode == 2
+        assert "--lint-json" in r.stderr
+
+    def test_max_lint_errors_malformed_report_exits_2(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 1.0})
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        lint = _write(tmp_path, "lint.json", {"summary": {}})
+        r = _run(base, cand, "--lint-json", lint, "--max-lint-errors", "0")
+        assert r.returncode == 2
+        assert "summary.errors" in r.stderr
+
+    @staticmethod
     def _chaos(value=1.0, leaks=0, inv=True, tl=True):
         return {"value": value,
                 "detail": {"slot_leaks": leaks, "invariants_ok": inv,
