@@ -67,6 +67,14 @@ page math, telemetry ``overhead_pct``) from the runtime cost model +
 SLO tracker; ``check_regression.py --min-goodput/--max-overhead-pct``
 gate on it.
 
+``--signatures <path>`` (serving-stall, paging): each arm exports (and
+merge-unions into) a ``signatures.json`` warmup manifest — the exact
+abstract signature each watched jitted program was traced with during
+warmup — for ``bin/graftlint --check --manifest`` and the
+``check_regression.py --require-signature-match`` gate: the statically
+enumerated reachable-signature set must equal the runtime warmup set
+in both directions.
+
 ``vs_baseline`` compares achieved model TFLOPS against the reference's
 headline single-device number: 64 TFLOPS/GPU for BERT-Large pretraining
 with DeepSpeed's fused kernels on V100-32GB (BASELINE.md row 1, reference
@@ -90,6 +98,10 @@ _JSON_PATH = None   # set by __main__ from --json <path>
 _TRACE_PATH = None  # set by __main__ from --trace <path>
 _DUMP_DIR = None    # set by __main__ from --dump-dir <path>; chaos-row
 #                     post-mortem JSONs land here (tmpdir if unset)
+_SIGNATURES_PATH = None  # set by __main__ from --signatures <path>;
+#                     serving rows export the runtime warmup manifest
+#                     (signatures.json) for graftlint --check / the
+#                     check_regression.py --require-signature-match gate
 
 
 def _emit(result: dict) -> None:
@@ -433,24 +445,36 @@ def serving_stall_main():
         budgets.append(int(gen.integers(gen_lo, gen_hi + 1)))
 
     def warm_arm(srv: ServingEngine) -> None:
-        """Compile every program the timed replay can reach BEFORE timing:
-        each (batch-bucket x width-bucket) admission combination the
-        token budget allows (driven through real closed-loop admissions,
-        so the pool's jitted multi-row admit warms too), the chunk
-        program at several offsets, decode and sampling. Warm-by-replay
-        is NOT enough — admission grouping depends on wall-clock
-        arrival interleaving, so a grouping first seen mid-timed-run
-        would compile inside a timed step and masquerade as a stall."""
-        w = 16
-        top = 1
-        while top < len_hi:
-            top *= 2
+        """Compile every program admission can EVER reach BEFORE timing —
+        the full statically-enumerable set (graftlint --check proves it
+        finite and equal to this sweep), not just the shapes this
+        workload's length distribution happens to hit: each singleton
+        width bucket up to the arm's clamp (one chunk when stall-free;
+        the capacity bucket when serial admission pads whole prompts),
+        each (batch-bucket x width-bucket) grouping the token budget
+        allows (driven through real closed-loop admissions, so the
+        pool's jitted multi-row admit warms too), the chunk program,
+        decode and sampling. Warm-by-replay is NOT enough — admission
+        grouping depends on wall-clock arrival interleaving, so a
+        grouping first seen mid-timed-run would compile inside a timed
+        step and masquerade as a stall."""
+        sf = srv._stall_free
+        w, top = 16, (chunk if sf else 1024)
         while w <= top:
-            for count in range(1, slots + 1):
-                for _ in range(count):
-                    srv.submit(np.ones((w,), np.int32), max_new_tokens=2)
-                srv.run_until_drained()
+            srv.submit(np.ones((min(w, long_hi),), np.int32),
+                       max_new_tokens=2)
+            srv.run_until_drained()
             w *= 2
+        if sf:
+            budget = 2 * chunk + 64 * slots  # == arm_sf construction
+            w = 16
+            while w <= chunk:
+                for count in range(2, min(slots, max(1, budget // w)) + 1):
+                    for _ in range(count):
+                        srv.submit(np.ones((w,), np.int32),
+                                   max_new_tokens=2)
+                    srv.run_until_drained()
+                w *= 2
         srv.submit(np.ones((long_hi,), np.int32), max_new_tokens=2)
         srv.run_until_drained()
 
@@ -501,6 +525,11 @@ def serving_stall_main():
     # max() rather than sum() avoids double-counting those)
     arm_sf.end_warmup()
     arm_serial.end_warmup()
+    if _SIGNATURES_PATH:
+        extra = {"vocab_size": cfg.vocab_size, "max_prompt_len": long_hi}
+        arm_sf.export_signatures(_SIGNATURES_PATH, merge=True, extra=extra)
+        arm_serial.export_signatures(_SIGNATURES_PATH, merge=True,
+                                     extra=extra)
     n_decode_programs = engine._jit_decode._cache_size()
 
     # interleaved replications with per-metric medians: single CPU
@@ -814,11 +843,33 @@ def paging_main():
                    for k in ("k", "v"))
 
     def run_arm(srv: ServingEngine, paged: bool) -> dict:
-        # compile this server's pool programs on prompts DISJOINT from
-        # the workload (the trie must stay cold for the measured run)
-        for _ in range(2):
-            srv.submit(np.zeros((ps // 2,), np.int32), max_new_tokens=2)
+        # compile this server's programs on prompts DISJOINT from the
+        # workload (the trie must stay cold for the measured run) by
+        # sweeping every admission grouping the static checker
+        # enumerates — each singleton width bucket up to the chunk,
+        # each (rows x width) group the prefill token budget allows,
+        # and one chunk-looped long prefill — not just the shapes this
+        # workload's length mix happens to hit. A distinct leading
+        # token per warm prompt keeps the sweep from prefix-hitting
+        # itself, so every entry drives the cold admission path it is
+        # meant to compile.
+        tok = 0
+
+        def warm(w: int, count: int) -> None:
+            nonlocal tok
+            for _ in range(count):
+                tok += 1
+                srv.submit(np.full((w,), tok, np.int32), max_new_tokens=2)
             srv.run_until_drained()
+
+        slots = slots_p if paged else slots_c
+        budget = 2 * ps   # the ServingEngine default this row runs with
+        w = 16
+        while w <= ps:
+            for count in range(1, min(slots, max(1, budget // w)) + 1):
+                warm(w, count)
+            w *= 2
+        warm(4 * ps, 1)   # long prefill: drives the chunk loop
         srv.reset_efficiency_window()   # efficiency covers the timed drain
         peak_live = peak_pages = guard = 0
         t0 = time.perf_counter()
@@ -875,6 +926,17 @@ def paging_main():
     # prefix hits, including the CoW forks the duplicates force) on the
     # measured paged server must not grow any executable cache
     srv_paged.end_warmup()
+    if _SIGNATURES_PATH:
+        # the manifest freezes at end_warmup: everything up to and
+        # including the measured run is warmup-eligible traffic the
+        # static enumeration must cover; the warm replay below is the
+        # post-warmup phase the invariant protects
+        extra = {"vocab_size": cfg.vocab_size,
+                 "max_seed_len": dup_len + gen_hi}
+        srv_paged.export_signatures(_SIGNATURES_PATH, merge=True,
+                                    extra=extra)
+        srv_dense.export_signatures(_SIGNATURES_PATH, merge=True,
+                                    extra=extra)
     if _TRACE_PATH:
         from deepspeed_tpu.telemetry import Tracer
 
@@ -1189,6 +1251,8 @@ if __name__ == "__main__":
         _TRACE_PATH = argv[argv.index("--trace") + 1]
     if "--dump-dir" in argv:
         _DUMP_DIR = argv[argv.index("--dump-dir") + 1]
+    if "--signatures" in argv:
+        _SIGNATURES_PATH = argv[argv.index("--signatures") + 1]
     if "serving-chaos" in argv:
         entry = serving_chaos_main
     elif "paging" in argv:

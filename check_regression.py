@@ -69,6 +69,24 @@ error (exit 2)::
     python check_regression.py BASE.json CAND.json \
         --lint-json LINT.json --max-lint-errors 0
 
+``--require-signature-match`` gates the zero-recompile invariant
+STATICALLY: it reads the ``signatures.json`` warmup manifest named by
+``--signatures-json FILE`` (exported by ``bench.py --signatures`` on
+the serving-stall and paging rows), re-enumerates the reachable
+abstract-signature set with graftcheck's interpreter under the
+manifest's recorded configs (stdlib ast only — no jax import), and
+fails on ANY divergence in either direction: a signature the warmup
+never traced will compile post-warmup; a runtime signature the static
+enumeration missed means the checker lost coverage. Like
+``--max-recompiles`` this is absolute on the candidate alone, and
+``--require-signature-match`` without ``--signatures-json`` is a usage
+error (exit 2)::
+
+    python bench.py serving-stall --json BENCH.json \
+        --signatures signatures.json
+    python check_regression.py BASE.json BENCH.json \
+        --signatures-json signatures.json --require-signature-match
+
 ``--warn-metric PATH[:higher|lower]`` runs the same relative
 comparison as ``--metric`` but never fails the gate — it prints
 ``WARNING`` instead of ``REGRESSION``. Use it for metrics that are
@@ -87,8 +105,29 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any, Tuple
+
+
+def _load_analysis():
+    """Import ``deepspeed_tpu.analysis`` standalone (stdlib ast only,
+    same trick as ``bin/graftlint``) so the signature gate never pays —
+    or depends on — the heavyweight jax import."""
+    import importlib.util
+
+    name = "_graftlint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "deepspeed_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg, "__init__.py"),
+        submodule_search_locations=[pkg])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _load(path: str) -> Any:
@@ -176,6 +215,15 @@ def main(argv=None) -> int:
                     help="absolute cap on summary.errors in the "
                          "--lint-json report (unsuppressed graftlint "
                          "errors; the serving gate uses 0)")
+    ap.add_argument("--signatures-json", metavar="FILE", default=None,
+                    help="a signatures.json warmup manifest (from "
+                         "`bench.py --signatures`) to gate with "
+                         "--require-signature-match")
+    ap.add_argument("--require-signature-match", action="store_true",
+                    help="absolute gate: graftcheck's statically "
+                         "enumerated signature set must equal the "
+                         "--signatures-json runtime warmup manifest in "
+                         "both directions (no jax import)")
     ap.add_argument("--require-zero-leaks", action="store_true",
                     help="absolute gate on the candidate's fault-"
                          "tolerance invariants (serving-chaos row): "
@@ -192,8 +240,36 @@ def main(argv=None) -> int:
         print("check_regression: --max-lint-errors requires --lint-json "
               "FILE (a `bin/graftlint --json` report)", file=sys.stderr)
         sys.exit(2)
+    if args.require_signature_match and args.signatures_json is None:
+        print("check_regression: --require-signature-match requires "
+              "--signatures-json FILE (a `bench.py --signatures` warmup "
+              "manifest)", file=sys.stderr)
+        sys.exit(2)
 
     failed = False
+    if args.require_signature_match:
+        man = _load(args.signatures_json)
+        progs = man.get("programs") if isinstance(man, dict) else None
+        if not isinstance(progs, dict):
+            print(f"check_regression: {args.signatures_json} is not a "
+                  "signatures.json manifest (missing 'programs')",
+                  file=sys.stderr)
+            sys.exit(2)
+        analysis = _load_analysis()
+        envs = man.get("configs") or analysis.default_check_envs()
+        res = analysis.enumerate_union(
+            envs, os.path.dirname(os.path.abspath(__file__)))
+        static = {k: sorted(v) for k, v in res.programs.items()}
+        diffs = [f"{f.path}:{f.line}: {f.rule}: {f.message}"
+                 for f in res.findings]
+        diffs += analysis.diff_manifest(static, progs)
+        worse = bool(diffs)
+        tag = "REGRESSION" if worse else "ok"
+        print(f"{tag:>10}  signatures [graftcheck] (absolute): "
+              f"{len(diffs)} divergence(s) vs {args.signatures_json}")
+        for d in diffs:
+            print(f"            {d}")
+        failed |= worse
     if args.max_lint_errors is not None:
         lint = _load(args.lint_json)
         e = _resolve(lint, "summary.errors", args.lint_json)

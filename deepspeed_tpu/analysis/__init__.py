@@ -26,13 +26,18 @@ heavyweight ``deepspeed_tpu`` package import).
 
 from .baseline import load_baseline, write_baseline  # noqa: F401
 from .findings import ERROR, INFO, WARNING, Finding  # noqa: F401
+from .interp import (default_check_envs, diff_manifest,  # noqa: F401
+                     enumerate_signatures, enumerate_union)
 from .pragmas import PragmaIndex  # noqa: F401
 from .rules import ALL_RULES, META_RULES, RULES_BY_ID  # noqa: F401
 from .runner import (Report, analyze_paths, analyze_source,  # noqa: F401
-                     iter_python_files, jit_inventory)
+                     check_paths, iter_python_files, jit_inventory)
+from .sharding_rules import CHECK_RULE_IDS, SHARDING_RULES  # noqa: F401
 
 __all__ = [
-    "ALL_RULES", "META_RULES", "RULES_BY_ID", "ERROR", "WARNING", "INFO",
-    "Finding", "PragmaIndex", "Report", "analyze_paths", "analyze_source",
-    "iter_python_files", "jit_inventory", "load_baseline", "write_baseline",
+    "ALL_RULES", "CHECK_RULE_IDS", "META_RULES", "RULES_BY_ID", "ERROR",
+    "WARNING", "INFO", "Finding", "PragmaIndex", "Report", "analyze_paths",
+    "analyze_source", "check_paths", "default_check_envs", "diff_manifest",
+    "enumerate_signatures", "enumerate_union", "iter_python_files",
+    "jit_inventory", "load_baseline", "write_baseline",
 ]

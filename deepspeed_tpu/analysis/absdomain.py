@@ -1,0 +1,367 @@
+"""Abstract value domain for graftcheck (see ``interp.py``).
+
+The domain models exactly what a jit cache key sees at the watched
+call seams: *top-level* argument structure.  Arrays are abstracted to
+``dtype[dim, ...]``; pytree containers (params, caches) to ``*`` — the
+serving invariants live in the small dense operands (bucketed widths,
+batch buckets, positions), not inside the parameter tree; Python
+scalars reaching a jit boundary in this codebase are always
+``static_argnums`` operands, so they render by *value*.
+
+Dims are members of a small integer lattice:
+
+* :class:`Known` — a concrete int (``8``),
+* :class:`IntRange` — an int in ``[lo, hi]`` (a prompt length),
+* :class:`FiniteSet` — one of an explicit finite set (``{2, 4, 8}``,
+  the power-of-two bucket sets the admission code produces),
+* :class:`Unbounded` — no finite bound could be established.
+
+A :class:`FiniteSet` keeps its python identity through the
+interpreter, so one abstract batch size flowing into several operand
+shapes of the same call expands *jointly* (``ids (nB, W)`` and
+``slots (nB,)`` always agree) while independent sets expand as a
+cartesian product.  :func:`expand_signatures` is the only place that
+expansion happens.
+
+Runtime twin: :func:`~deepspeed_tpu.telemetry.watchdog.manifest_signature`
+renders live call args with the same grammar; the two must stay
+byte-identical for the manifest diff to mean anything (pinned by
+tests/unit/analysis/test_signatures.py round-trip fixtures).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+#: expanding one call site beyond this many concrete signatures is
+#: reported as unbounded rather than enumerated — a legitimate serving
+#: program has log2-bounded bucket sets, not hundreds of variants
+MAX_SIGNATURES_PER_SITE = 512
+
+# placements for the placement-mix rule (PR-5/PR-8 incident class):
+# HOST values (numpy) adopt the committed layout of the pool they meet;
+# UNCOMMITTED jnp allocations carry their own default layout and force
+# a second executable when mixed with committed state.
+HOST = "host"
+COMMITTED = "committed"
+UNCOMMITTED = "uncommitted"
+
+
+class Dim:
+    """Base class for abstract integer dimensions."""
+
+    def values(self) -> Optional[Tuple[int, ...]]:
+        """Concrete candidates, or None when unbounded."""
+        raise NotImplementedError
+
+
+class Known(Dim):
+    __slots__ = ("v",)
+
+    def __init__(self, v: int):
+        self.v = int(v)
+
+    def values(self):
+        return (self.v,)
+
+    def __repr__(self):
+        return f"Known({self.v})"
+
+
+class IntRange(Dim):
+    """An integer somewhere in ``[lo, hi]`` (inclusive)."""
+
+    __slots__ = ("lo", "hi", "name")
+
+    def __init__(self, lo: int, hi: int, name: str = ""):
+        self.lo, self.hi, self.name = int(lo), int(hi), name
+
+    def clamp(self, lo: Optional[int] = None,
+              hi: Optional[int] = None) -> "IntRange":
+        nlo = self.lo if lo is None else max(self.lo, lo)
+        nhi = self.hi if hi is None else min(self.hi, hi)
+        return IntRange(nlo, nhi, self.name)
+
+    def values(self):
+        # a raw range is only enumerable when small; bucket functions
+        # are expected to collapse ranges into FiniteSets first
+        if self.hi - self.lo + 1 <= MAX_SIGNATURES_PER_SITE:
+            return tuple(range(self.lo, self.hi + 1))
+        return None
+
+    def __repr__(self):
+        n = f" {self.name}" if self.name else ""
+        return f"IntRange({self.lo}..{self.hi}{n})"
+
+
+class FiniteSet(Dim):
+    """One of an explicit, small set of ints.  Identity matters: the
+    same object appearing in several shapes expands jointly."""
+
+    __slots__ = ("vals", "name")
+
+    def __init__(self, vals: Iterable[int], name: str = ""):
+        self.vals = tuple(sorted({int(v) for v in vals}))
+        self.name = name
+        if not self.vals:
+            raise ValueError("FiniteSet needs at least one value")
+
+    def values(self):
+        return self.vals
+
+    def __repr__(self):
+        n = f" {self.name}" if self.name else ""
+        return f"FiniteSet({list(self.vals)}{n})"
+
+
+class Unbounded(Dim):
+    __slots__ = ("why",)
+
+    def __init__(self, why: str = ""):
+        self.why = why
+
+    def values(self):
+        return None
+
+    def __repr__(self):
+        return f"Unbounded({self.why})"
+
+
+def pow2_buckets(lo: int, hi: int, name: str = "") -> FiniteSet:
+    """The power-of-two set ``{lo, 2*lo, ..} ∩ [lo, >=hi]`` produced by
+    the admission code's doubling loops (``b = MIN; while b < n: b *= 2``)."""
+    vals = []
+    b = int(lo)
+    while True:
+        vals.append(b)
+        if b >= hi:
+            break
+        b *= 2
+    return FiniteSet(vals, name)
+
+
+def dim_of(x: Any) -> Dim:
+    if isinstance(x, Dim):
+        return x
+    if isinstance(x, bool):
+        raise TypeError("bool is not a dim")
+    if isinstance(x, int):
+        return Known(x)
+    raise TypeError(f"not a dim: {x!r}")
+
+
+# ----------------------------------------------------------------------
+# abstract values
+# ----------------------------------------------------------------------
+class AbsValue:
+    """Base class for abstract runtime values."""
+
+
+class Arr(AbsValue):
+    """An array-like (numpy or jax) with abstract shape/dtype and a
+    placement tag for the placement-mix rule."""
+
+    __slots__ = ("shape", "dtype", "placement")
+
+    def __init__(self, shape: Sequence[Any], dtype: str,
+                 placement: str = HOST):
+        self.shape: Tuple[Dim, ...] = tuple(dim_of(d) for d in shape)
+        self.dtype = str(dtype)
+        self.placement = placement
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def with_dtype(self, dtype: str) -> "Arr":
+        return Arr(self.shape, dtype, self.placement)
+
+    def with_placement(self, placement: str) -> "Arr":
+        return Arr(self.shape, self.dtype, placement)
+
+    def __repr__(self):
+        return f"Arr({self.dtype}[{', '.join(map(repr, self.shape))}])"
+
+
+class Tree(AbsValue):
+    """An opaque pytree container (params / cache / prefill cache):
+    renders as ``*``.  Carries a placement for the placement-mix rule."""
+
+    __slots__ = ("placement", "label")
+
+    def __init__(self, placement: str = COMMITTED, label: str = ""):
+        self.placement = placement
+        self.label = label
+
+    def __repr__(self):
+        return f"Tree({self.label or '*'})"
+
+
+class Scalar(AbsValue):
+    """A python scalar reaching a call boundary.  ``value`` may be a
+    concrete python value (rendered by repr) or a :class:`Dim` for an
+    abstract int."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+    def as_dim(self) -> Dim:
+        if isinstance(self.value, Dim):
+            return self.value
+        if isinstance(self.value, bool):
+            raise TypeError("bool scalar is not a dim")
+        if isinstance(self.value, int):
+            return Known(self.value)
+        raise TypeError(f"not an int scalar: {self.value!r}")
+
+    def __repr__(self):
+        return f"Scalar({self.value!r})"
+
+
+class Tup(AbsValue):
+    """A python tuple/list of abstract values (NOT an operand pytree —
+    use :class:`Tree` for those).  Exists so multi-value returns can be
+    unpacked."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: Sequence[AbsValue]):
+        self.items = tuple(items)
+
+    def __repr__(self):
+        return f"Tup({list(self.items)!r})"
+
+
+class Obj(AbsValue):
+    """A host object with modelled attributes (a Request, a pool)."""
+
+    __slots__ = ("kind", "attrs")
+
+    def __init__(self, kind: str, attrs: Optional[dict] = None):
+        self.kind = kind
+        self.attrs = dict(attrs or {})
+
+    def __repr__(self):
+        return f"Obj({self.kind})"
+
+
+class Unknown(AbsValue):
+    """Analysis gave up on this value; reaching a watched call operand
+    with one of these is the ``signature-escape`` finding."""
+
+    __slots__ = ("why",)
+
+    def __init__(self, why: str = ""):
+        self.why = why
+
+    def __repr__(self):
+        return f"Unknown({self.why})"
+
+
+# ----------------------------------------------------------------------
+# signature rendering
+# ----------------------------------------------------------------------
+class SignatureError(ValueError):
+    """A call's operands cannot be rendered into a finite signature
+    set.  ``kind`` is the rule id the caller should report."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind
+
+
+def _collect_dims(vals: Sequence[AbsValue]) -> List[Dim]:
+    """Distinct non-Known dims across the operand shapes, by identity."""
+    out: List[Dim] = []
+    seen = set()
+    for v in vals:
+        dims: Tuple[Dim, ...] = ()
+        if isinstance(v, Arr):
+            dims = v.shape
+        elif isinstance(v, Scalar) and isinstance(v.value, Dim):
+            dims = (v.value,)
+        for d in dims:
+            if isinstance(d, Known):
+                continue
+            if id(d) not in seen:
+                seen.add(id(d))
+                out.append(d)
+    return out
+
+
+def _render_one(v: AbsValue, env: dict) -> str:
+    if isinstance(v, Arr):
+        parts = []
+        for d in v.shape:
+            if id(d) in env:
+                parts.append(str(env[id(d)]))
+            elif isinstance(d, Known):
+                parts.append(str(d.v))
+            else:  # pragma: no cover - guarded by _collect_dims
+                raise SignatureError("signature-escape",
+                                     f"unexpanded dim {d!r}")
+        return f"{v.dtype}[{','.join(parts)}]"
+    if isinstance(v, Tree):
+        return "*"
+    if isinstance(v, Scalar):
+        val = v.value
+        if isinstance(val, Dim):
+            if id(val) in env:
+                return repr(env[id(val)])
+            if isinstance(val, Known):
+                return repr(val.v)
+            raise SignatureError("signature-escape",
+                                 f"unexpanded scalar dim {val!r}")
+        return repr(val)
+    if isinstance(v, Unknown):
+        raise SignatureError(
+            "signature-escape",
+            f"operand value escaped the abstract domain"
+            f"{': ' + v.why if v.why else ''}")
+    raise SignatureError("signature-escape",
+                         f"unrenderable operand {type(v).__name__}")
+
+
+def expand_signatures(args: Sequence[AbsValue],
+                      kwargs: Optional[dict] = None) -> List[str]:
+    """All concrete manifest signatures this abstract call expands to.
+
+    Dims expand by object identity — one :class:`FiniteSet` appearing
+    in several operand shapes takes the same value in every expansion.
+    Raises :class:`SignatureError` (kind ``unbounded-signature``) when
+    any dim has no finite candidate set or the cartesian product
+    exceeds :data:`MAX_SIGNATURES_PER_SITE`, and (kind
+    ``signature-escape``) when an operand is :class:`Unknown`.
+    """
+    kwargs = kwargs or {}
+    ordered = list(args) + [kwargs[k] for k in sorted(kwargs)]
+    for v in ordered:  # fail fast on escapes before expanding
+        if isinstance(v, Unknown):
+            _render_one(v, {})
+    dims = _collect_dims(ordered)
+    axes = []
+    total = 1
+    for d in dims:
+        vals = d.values()
+        if vals is None:
+            raise SignatureError(
+                "unbounded-signature",
+                f"dim {d!r} has no finite bound")
+        total *= len(vals)
+        if total > MAX_SIGNATURES_PER_SITE:
+            raise SignatureError(
+                "unbounded-signature",
+                f"signature set exceeds {MAX_SIGNATURES_PER_SITE} "
+                f"concrete variants")
+        axes.append(vals)
+    out = []
+    names = sorted(kwargs)
+    for combo in itertools.product(*axes) if axes else [()]:
+        env = {id(d): val for d, val in zip(dims, combo)}
+        parts = [_render_one(a, env) for a in args]
+        parts += [f"{k}={_render_one(kwargs[k], env)}" for k in names]
+        out.append("(" + ", ".join(parts) + ")")
+    return sorted(set(out))

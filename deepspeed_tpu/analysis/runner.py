@@ -109,10 +109,18 @@ def _relpath(path: str) -> str:
 
 
 def analyze_source(source: str, path: str = "<memory>",
-                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
-    """Run rules + pragma handling over one in-memory module."""
+                   rules: Optional[Sequence[Rule]] = None,
+                   extra_findings: Optional[Sequence[Finding]] = None
+                   ) -> List[Finding]:
+    """Run rules + pragma handling over one in-memory module.
+
+    ``extra_findings`` are pre-computed findings for this file (the
+    abstract interpreter's project-level signature findings); they join
+    the rule findings *before* pragma application so ``allow[...]``
+    comments and fingerprints treat them like any rule output.
+    """
     rules = list(rules) if rules is not None else list(ALL_RULES)
-    findings: List[Finding] = []
+    findings: List[Finding] = list(extra_findings or [])
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -138,6 +146,12 @@ def analyze_source(source: str, path: str = "<memory>",
                 f.suppress_reason = p.reason
             # a reasonless pragma does NOT suppress: the finding stays
             # an error and the pragma itself is flagged below
+    # a pragma naming only rules that did not run this pass (e.g. an
+    # allow[signature-escape] seen by a lint-only run) is not stale —
+    # it belongs to another tier
+    active_ids = {r.id for r in rules}
+    if extra_findings:
+        active_ids.update(f.rule for f in extra_findings)
     for p in pragmas.all_pragmas():
         if not p.reason:
             findings.append(Finding(
@@ -147,6 +161,8 @@ def analyze_source(source: str, path: str = "<memory>",
                         "suppression must say why the invariant does "
                         "not apply here"))
         elif not p.used:
+            if "*" not in p.rules and not p.rules & active_ids:
+                continue  # pragma is for a tier that did not run
             findings.append(Finding(
                 rule="unused-pragma", severity=WARNING, path=path,
                 line=p.line, col=1,
@@ -175,6 +191,70 @@ def analyze_paths(paths: Sequence[str],
         report.files += 1
         report.findings.extend(
             analyze_source(source, _relpath(fp), rules))
+
+    if baseline:
+        apply_baseline(report.findings, load_baseline(baseline))
+    return report
+
+
+def check_paths(paths: Sequence[str],
+                root: str = ".",
+                envs: Optional[Sequence[dict]] = None,
+                select: Optional[Iterable[str]] = None,
+                ignore: Optional[Iterable[str]] = None,
+                baseline: Optional[str] = None) -> Report:
+    """The ``--check`` tier: lint rules + sharding rules over ``paths``
+    plus the abstract interpreter's signature enumeration over the
+    serving stack under ``root``.
+
+    Interpreter findings (``signature-escape`` / ``unbounded-signature``)
+    are merged into their source file's finding list before pragma
+    application, so they suppress and fingerprint exactly like rule
+    output.  Manifest comparison is separate (see ``cli.py``): a
+    static/runtime divergence is a CI diff, not a source finding.
+    """
+    from .interp import default_check_envs, enumerate_union
+    from .rules import ALL_RULES as _LINT_RULES
+    from .sharding_rules import SHARDING_RULES
+
+    rules: List[Rule] = list(_LINT_RULES) + list(SHARDING_RULES)
+    if select:
+        chosen = set(select)
+        rules = [r for r in rules if r.id in chosen]
+    if ignore:
+        dropped = set(ignore)
+        rules = [r for r in rules if r.id not in dropped]
+
+    res = enumerate_union(envs if envs is not None
+                          else default_check_envs(), root)
+    by_file: Dict[str, List[Finding]] = {}
+    for f in res.findings:
+        by_file.setdefault(f.path, []).append(f)
+
+    report = Report()
+    seen_files = set()
+    for fp in iter_python_files(paths):
+        rel = _relpath(fp)
+        seen_files.add(rel)
+        with open(fp, encoding="utf-8") as fh:
+            source = fh.read()
+        report.files += 1
+        report.findings.extend(analyze_source(
+            source, rel, rules, extra_findings=by_file.get(rel, [])))
+    # interpreter findings in files outside `paths` still count — the
+    # enumeration is a whole-project property
+    for rel, extra in by_file.items():
+        if rel in seen_files:
+            continue
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            report.findings.extend(extra)
+            continue
+        report.files += 1
+        report.findings.extend(analyze_source(source, rel, [],
+                                              extra_findings=extra))
 
     if baseline:
         apply_baseline(report.findings, load_baseline(baseline))

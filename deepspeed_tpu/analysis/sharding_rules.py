@@ -1,0 +1,510 @@
+"""graftcheck sharding-consistency rules (the ``--check`` tier).
+
+Four rules guard the mesh/sharding seams ahead of multi-chip serving
+(ROADMAP item 1).  All are per-module AST rules that plug into the
+same runner as the graftlint incident rules:
+
+=========================  ==============================================
+rule id                    invariant
+=========================  ==============================================
+mesh-axis-unknown          every axis name in a ``PartitionSpec`` must be
+                           an axis the mesh actually declares (t5x-style
+                           LogicalAxisRules validation) — a typo'd axis
+                           silently replicates instead of sharding
+shard-indivisible          a dim sharded over a mesh axis must be
+                           statically divisible by that axis's declared
+                           size, or GSPMD pads/reshards silently
+donation-alias-mismatch    a ``donate_argnums`` operand must flow into
+                           the traced function's results — otherwise the
+                           donated buffer cannot alias any output and the
+                           donation is a silent no-op (or an XLA error
+                           once layouts differ)
+placement-mix              traced code must not combine a committed
+                           (``jax.device_put`` with sharding) value and a
+                           fresh uncommitted ``jnp.*`` allocation in one
+                           op: the PR-5/PR-8 double-executable class.
+                           numpy-derived values are neutral — they adopt
+                           the committed layout (the known-FP guard)
+=========================  ==============================================
+
+Axis universes and sizes are only trusted when they are *statically
+declared* (string-literal ``*_AXIS`` constants / ``MESH_AXES`` tuples,
+int-literal ``MeshConfig``/``build_mesh`` keywords).  Anything dynamic
+makes the rule stay silent rather than guess.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .dataflow import (flatten_statements, node_path, reads_tainted,
+                       target_paths, walk_exprs)
+from .findings import ERROR, Finding
+from .rules import ModuleContext, Rule
+
+#: allocators whose results carry an *uncommitted* default layout
+_UNCOMMITTED_ALLOCS = {
+    "jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty", "jnp.arange",
+    "jnp.zeros_like", "jnp.ones_like", "jnp.full_like",
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+}
+#: host-side allocators: neutral, they adopt whatever layout they meet
+_HOST_ALLOCS = {
+    "np.zeros", "np.ones", "np.full", "np.empty", "np.asarray",
+    "np.array", "np.arange", "numpy.zeros", "numpy.asarray",
+}
+
+
+def _pspec_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to ``jax.sharding.PartitionSpec``."""
+    out = {"PartitionSpec", "jax.sharding.PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and \
+                (node.module or "").endswith("sharding"):
+            for al in node.names:
+                if al.name == "PartitionSpec":
+                    out.add(al.asname or al.name)
+    return out
+
+
+def _namedsharding_aliases(tree: ast.Module) -> Set[str]:
+    out = {"NamedSharding", "jax.sharding.NamedSharding"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and \
+                (node.module or "").endswith("sharding"):
+            for al in node.names:
+                if al.name == "NamedSharding":
+                    out.add(al.asname or al.name)
+    return out
+
+
+def _module_axis_decls(tree: ast.Module) -> Tuple[Set[str], Dict[str, str]]:
+    """Axis names a module itself declares.
+
+    Returns ``(axes, const_map)``: string constants assigned to
+    ``*_AXIS`` names, string elements of ``*_AXES`` tuples, and axis
+    tuples passed to ``Mesh(...)`` / ``ProcessTopology([...], ...)``
+    constructors.  ``const_map`` maps the constant NAME to its axis
+    string so ``PartitionSpec(MODEL_AXIS)`` resolves.
+    """
+    axes: Set[str] = set()
+    const_map: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            v = node.value
+            if name.endswith("_AXIS") and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str):
+                axes.add(v.value)
+                const_map[name] = v.value
+            elif name.endswith("_AXES") and isinstance(v, ast.Tuple):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, str):
+                        axes.add(e.value)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            ctor = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if ctor in ("Mesh", "ProcessTopology") and node.args:
+                cand = node.args[1] if ctor == "Mesh" and \
+                    len(node.args) > 1 else node.args[0]
+                if ctor == "ProcessTopology":
+                    cand = node.args[0]
+                if isinstance(cand, (ast.Tuple, ast.List)):
+                    for e in cand.elts:
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, str):
+                            axes.add(e.value)
+    return axes, const_map
+
+
+_MESH_MODULE_CACHE: Dict[str, Tuple[Set[str], Dict[str, str]]] = {}
+
+
+def declared_mesh_axes(ctx_path: str) -> Tuple[Set[str], Dict[str, str]]:
+    """The project's mesh-axis universe: parsed from
+    ``deepspeed_tpu/parallel/mesh.py``, located by walking up from the
+    analyzed file.  Unlocatable (fixture tests) → empty, and the rules
+    fall back to what the module itself declares."""
+    d = os.path.dirname(os.path.abspath(ctx_path))
+    for _ in range(8):
+        cand = os.path.join(d, "deepspeed_tpu", "parallel", "mesh.py")
+        if os.path.isfile(cand):
+            if cand not in _MESH_MODULE_CACHE:
+                try:
+                    with open(cand, encoding="utf-8") as fh:
+                        tree = ast.parse(fh.read())
+                    _MESH_MODULE_CACHE[cand] = _module_axis_decls(tree)
+                except (OSError, SyntaxError):
+                    _MESH_MODULE_CACHE[cand] = (set(), {})
+            return _MESH_MODULE_CACHE[cand]
+        nxt = os.path.dirname(d)
+        if nxt == d:
+            break
+        d = nxt
+    return set(), {}
+
+
+def _spec_axis_entries(call: ast.Call) -> List[Tuple[ast.expr, List[str]]]:
+    """(node, axis names) per PartitionSpec entry that names axes via
+    string literals or tuples of string literals.  Name references are
+    returned with the *constant name* prefixed ``@`` for resolution."""
+    out: List[Tuple[ast.expr, List[str]]] = []
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg, [arg.value]))
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            names = []
+            for e in arg.elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str):
+                    names.append(e.value)
+                elif isinstance(e, ast.Name):
+                    names.append("@" + e.id)
+                elif isinstance(e, ast.Attribute):
+                    names.append("@" + e.attr)
+            if names:
+                out.append((arg, names))
+        elif isinstance(arg, ast.Name):
+            out.append((arg, ["@" + arg.id]))
+        elif isinstance(arg, ast.Attribute):
+            out.append((arg, ["@" + arg.attr]))
+    return out
+
+
+class MeshAxisUnknownRule(Rule):
+    id = "mesh-axis-unknown"
+    severity = ERROR
+    short = ("PartitionSpec names a mesh axis the declared mesh does "
+             "not have")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        proj_axes, proj_consts = declared_mesh_axes(ctx.path)
+        mod_axes, mod_consts = _module_axis_decls(ctx.tree)
+        axes = proj_axes | mod_axes
+        consts = dict(proj_consts)
+        consts.update(mod_consts)
+        if not axes:
+            return  # no statically-declared mesh anywhere: stay silent
+        pspec = _pspec_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            p = node_path(node.func)
+            if p not in pspec:
+                continue
+            for entry, names in _spec_axis_entries(node):
+                for name in names:
+                    if name.startswith("@"):
+                        # a *_AXIS constant reference: resolvable ones
+                        # are checked, anything else is dynamic → skip
+                        resolved = consts.get(name[1:])
+                        if resolved is None or resolved in axes:
+                            continue
+                        name = resolved
+                    if name not in axes:
+                        yield self.finding(
+                            ctx, entry,
+                            f"PartitionSpec axis `{name}` is not a "
+                            f"declared mesh axis (mesh declares: "
+                            f"{', '.join(sorted(axes))})")
+
+
+def _literal_shape(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The shape of a literal allocator call (``jnp.zeros((8, 16))``)."""
+    if not call.args:
+        return None
+    sh = call.args[0]
+    if isinstance(sh, ast.Constant) and isinstance(sh.value, int):
+        return (sh.value,)
+    if isinstance(sh, (ast.Tuple, ast.List)):
+        dims = []
+        for e in sh.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                dims.append(e.value)
+            else:
+                return None
+        return tuple(dims)
+    return None
+
+
+def _axis_size_hints(tree: ast.Module) -> Dict[str, int]:
+    """Int-literal axis sizes declared in the module: keyword args of
+    ``MeshConfig``/``build_mesh``/``initialize_mesh`` calls."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if name not in ("MeshConfig", "build_mesh", "initialize_mesh"):
+            continue
+        for kw in node.keywords:
+            if kw.arg and isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, int) and kw.value.value > 0:
+                out[kw.arg] = kw.value.value
+    return out
+
+
+class ShardIndivisibleRule(Rule):
+    id = "shard-indivisible"
+    severity = ERROR
+    short = ("array dim not statically divisible by the mesh axis it "
+             "is sharded over")
+
+    _SINKS = {"jax.device_put", "jax.lax.with_sharding_constraint",
+              "with_sharding_constraint", "device_put"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        sizes = _axis_size_hints(ctx.tree)
+        if not sizes:
+            return  # axis sizes are runtime (device count): stay silent
+        pspec = _pspec_aliases(ctx.tree)
+        _, consts = _module_axis_decls(ctx.tree)
+        proj_axes, proj_consts = declared_mesh_axes(ctx.path)
+        merged = dict(proj_consts)
+        merged.update(consts)
+        for fi in ctx.index.functions.values():
+            if not hasattr(fi.node, "body"):
+                continue
+            shapes: Dict[str, Tuple[int, ...]] = {}
+            for stmt in flatten_statements(fi.node):
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Call):
+                    p = node_path(stmt.value.func)
+                    if p in _UNCOMMITTED_ALLOCS or p in _HOST_ALLOCS:
+                        sh = _literal_shape(stmt.value)
+                        if sh is not None:
+                            for t in stmt.targets:
+                                for tp in target_paths(t):
+                                    shapes[tp] = sh
+                for expr in walk_exprs(stmt):
+                    if isinstance(expr, ast.Call) and \
+                            node_path(expr.func) in self._SINKS:
+                        yield from self._check_sink(
+                            ctx, fi, expr, shapes, sizes, pspec, merged)
+
+    def _check_sink(self, ctx, fi, call, shapes, sizes, pspec,
+                    consts) -> Iterator[Finding]:
+        if len(call.args) < 2:
+            return
+        arr, sharding = call.args[0], call.args[1]
+        shape: Optional[Tuple[int, ...]] = None
+        if isinstance(arr, ast.Name):
+            shape = shapes.get(arr.id)
+        elif isinstance(arr, ast.Call):
+            p = node_path(arr.func)
+            if p in _UNCOMMITTED_ALLOCS or p in _HOST_ALLOCS:
+                shape = _literal_shape(arr)
+        if shape is None:
+            return
+        spec = self._find_pspec(sharding, pspec)
+        if spec is None:
+            return
+        for i, arg in enumerate(spec.args):
+            if i >= len(shape):
+                break
+            names: List[str] = []
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                names = [arg.value]
+            elif isinstance(arg, (ast.Name, ast.Attribute)):
+                n = arg.id if isinstance(arg, ast.Name) else arg.attr
+                if n in consts:
+                    names = [consts[n]]
+            elif isinstance(arg, (ast.Tuple, ast.List)):
+                for e in arg.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, str):
+                        names.append(e.value)
+            total = 1
+            known = True
+            for n in names:
+                if n in sizes:
+                    total *= sizes[n]
+                else:
+                    known = False
+            if names and known and total > 1 and shape[i] % total != 0:
+                yield self.finding(
+                    ctx, arg,
+                    f"dim {i} of shape {tuple(shape)} is sharded over "
+                    f"axis {'+'.join(names)} of size {total} but "
+                    f"{shape[i]} % {total} != 0 — GSPMD will pad or "
+                    f"reshard silently", fi.qualname)
+
+    @staticmethod
+    def _find_pspec(node: ast.expr, pspec: Set[str]) -> Optional[ast.Call]:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and node_path(n.func) in pspec:
+                return n
+        return None
+
+
+class DonationAliasMismatchRule(Rule):
+    id = "donation-alias-mismatch"
+    severity = ERROR
+    short = ("donate_argnums operand never flows into the traced "
+             "function's results — the donated buffer cannot alias "
+             "any output")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        by_qual = {fi.qualname: fi
+                   for fi in ctx.index.functions.values()}
+        for b in ctx.index.bindings:
+            if not b.donate_argnums or not b.target_qualname:
+                continue
+            fi = by_qual.get(b.target_qualname)
+            if fi is None or not hasattr(fi.node, "body"):
+                continue
+            params = fi.param_names()
+            if params and params[0] in ("self", "cls"):
+                params = params[1:]
+            for argnum in b.donate_argnums:
+                if argnum >= len(params):
+                    continue
+                donor = params[argnum]
+                if not self._reaches_return(fi, donor):
+                    yield Finding(
+                        rule=self.id, severity=self.severity,
+                        path=ctx.path, line=b.lineno, col=1,
+                        message=(
+                            f"donate_argnums={argnum} donates "
+                            f"`{donor}` to `{b.target_qualname}` but no "
+                            f"return value derives from it; the buffer "
+                            f"cannot be aliased to any output"),
+                        func=b.target_qualname)
+
+    @staticmethod
+    def _reaches_return(fi, donor: str) -> bool:
+        tainted: Set[str] = {donor}
+        if isinstance(fi.node, ast.Lambda):
+            return reads_tainted(fi.node.body, tainted)
+        stmts = flatten_statements(fi.node)
+        # fixpoint over the straight-lined body: loops/branches are
+        # flattened, so two passes close simple forward chains
+        for _ in range(2):
+            for stmt in stmts:
+                if isinstance(stmt, ast.Assign):
+                    if reads_tainted(stmt.value, tainted):
+                        for t in stmt.targets:
+                            tainted.update(target_paths(t))
+                elif isinstance(stmt, ast.AugAssign):
+                    if reads_tainted(stmt.value, tainted) or \
+                            reads_tainted(stmt.target, tainted):
+                        tainted.update(target_paths(stmt.target))
+        for stmt in stmts:
+            if isinstance(stmt, ast.Return) and stmt.value is not None \
+                    and reads_tainted(stmt.value, tainted):
+                return True
+        return False
+
+
+class PlacementMixRule(Rule):
+    id = "placement-mix"
+    severity = ERROR
+    short = ("traced code combines a committed (device_put) value with "
+             "an uncommitted jnp allocation in one op")
+
+    _COMMITTED_SRC = {"jax.device_put", "device_put"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fi in ctx.index.traced_functions():
+            if not hasattr(fi.node, "body"):
+                continue
+            committed: Set[str] = set()
+            uncommitted: Set[str] = set()
+            for stmt in flatten_statements(fi.node):
+                for expr in walk_exprs(stmt):
+                    f = self._mix_at(expr, committed, uncommitted)
+                    if f is not None:
+                        yield self.finding(
+                            ctx, f,
+                            "committed (device_put) and uncommitted "
+                            "(fresh jnp allocation) values meet in one "
+                            "op inside traced code; the mixed layouts "
+                            "compile a second executable — commit both "
+                            "or neither (numpy inputs are neutral)",
+                            fi.qualname)
+                self._propagate(stmt, committed, uncommitted)
+
+    def _placement_of_expr(self, expr: ast.expr, committed: Set[str],
+                           uncommitted: Set[str]) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            p = node_path(expr.func)
+            if p in self._COMMITTED_SRC:
+                return "committed"
+            if p in _UNCOMMITTED_ALLOCS:
+                return "uncommitted"
+            return None
+        p = node_path(expr)
+        if p is None:
+            return None
+        if p in committed:
+            return "committed"
+        if p in uncommitted:
+            return "uncommitted"
+        return None
+
+    def _mix_at(self, expr: ast.AST, committed: Set[str],
+                uncommitted: Set[str]) -> Optional[ast.AST]:
+        operands: List[ast.expr] = []
+        if isinstance(expr, ast.BinOp):
+            operands = [expr.left, expr.right]
+        elif isinstance(expr, ast.Call):
+            p = node_path(expr.func) or ""
+            if p.startswith(("jnp.", "jax.lax.", "jax.numpy.")):
+                operands = list(expr.args)
+        if len(operands) < 2:
+            return None
+        tags = {self._placement_of_expr(o, committed, uncommitted)
+                for o in operands}
+        if "committed" in tags and "uncommitted" in tags:
+            return expr
+        return None
+
+    def _propagate(self, stmt: ast.stmt, committed: Set[str],
+                   uncommitted: Set[str]) -> None:
+        if not isinstance(stmt, ast.Assign):
+            return
+        tag = None
+        v = stmt.value
+        if isinstance(v, ast.Call):
+            p = node_path(v.func)
+            if p in self._COMMITTED_SRC:
+                tag = "committed"
+            elif p in _UNCOMMITTED_ALLOCS:
+                tag = "uncommitted"
+            elif p in _HOST_ALLOCS:
+                tag = "neutral"
+        if tag is None:
+            if reads_tainted(v, committed):
+                tag = "committed"
+            elif reads_tainted(v, uncommitted):
+                tag = "uncommitted"
+        for t in stmt.targets:
+            for tp in target_paths(t):
+                committed.discard(tp)
+                uncommitted.discard(tp)
+                if tag == "committed":
+                    committed.add(tp)
+                elif tag == "uncommitted":
+                    uncommitted.add(tp)
+
+
+#: the ``--check`` tier catalog (separate from graftlint's ALL_RULES so
+#: the lint tier's behaviour — and its pinned gate test — is unchanged)
+SHARDING_RULES: List[Rule] = [
+    MeshAxisUnknownRule(),
+    ShardIndivisibleRule(),
+    DonationAliasMismatchRule(),
+    PlacementMixRule(),
+]
+
+#: every check-tier rule id, including the two produced by the
+#: abstract interpreter rather than a per-module Rule object
+CHECK_RULE_IDS: Set[str] = {r.id for r in SHARDING_RULES} | {
+    "signature-escape", "unbounded-signature"}

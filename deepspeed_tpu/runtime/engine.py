@@ -1033,6 +1033,7 @@ class DeepSpeedEngine:
         self._grad_acc = None
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        # graftlint: allow[hot-loop-host-sync] -- the overflow flag must reach the host once per optimizer step to count skipped steps; a training step is not the serving decode loop
         if not self._offload_enabled and bool(metrics["overflow"]):
             self.skipped_steps += 1  # offload path counts inside _host_optimizer_step
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):

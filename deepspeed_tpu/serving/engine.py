@@ -397,6 +397,66 @@ class ServingEngine:
         mode at the next step boundary)."""
         self.watchdog.end_warmup()
 
+    # -- warmup signature manifest (graftcheck witness) -----------------
+    def _signature_env(self) -> dict:
+        """The serving config knobs that determine the reachable jit
+        signature set — the ``configs`` entry graftcheck re-enumerates
+        under when diffing a manifest (analysis/interp.py drivers)."""
+        pool = self.pool
+        return {
+            "num_slots": int(pool.num_slots),
+            "capacity": int(pool.capacity),
+            "prefill_chunk": int(self.prefill_chunk or 0),
+            "prefill_token_budget": int(self.prefill_token_budget or 0),
+            "paged": bool(self._paged),
+            "page_size": int(getattr(pool, "page_size", 0) or 0),
+            "num_pages": int(getattr(pool, "num_pages", 0) or 0),
+            "pages_per_slot": int(getattr(pool, "pages_per_slot", 0) or 0),
+            "top_k": int(self.top_k or 0),
+            "top_p": float(self.top_p),
+            "temperature": float(self.temperature),
+            "greedy": bool(np.asarray(self._greedy)),
+            "spec_k": int(self._spec.k) if self._spec is not None else 0,
+            "guard_numerics": self._jit_finite is not None,
+            "use_prefix": bool(self._use_prefix),
+            "stall_free": bool(self._stall_free),
+        }
+
+    def export_signatures(self, path: str, merge: bool = False,
+                          extra: Optional[dict] = None) -> dict:
+        """Write (or merge into) a ``signatures.json`` warmup manifest:
+        ``{"version": 1, "configs": [env...], "programs": {name:
+        [sorted sigs]}}``.
+
+        ``merge=True`` unions with an existing file — bench rows run
+        several serving arms against one shared inference engine, so
+        the shared engine jits see every arm's traffic and the manifest
+        is only meaningful as the union.  ``extra`` adds workload keys
+        the config alone cannot know (vocab size, prompt-length sweep
+        bounds)."""
+        import json
+        import os
+
+        env = self._signature_env()
+        if extra:
+            env.update(extra)
+        programs = self.watchdog.signature_manifest()
+        doc = {"version": 1, "configs": [env], "programs": programs}
+        if merge and os.path.exists(path):
+            with open(path, encoding="utf-8") as fh:
+                old = json.load(fh)
+            configs = [c for c in old.get("configs", []) if c != env]
+            doc["configs"] = configs + [env]
+            merged = {k: set(v) for k, v in old.get("programs", {}).items()}
+            for name, sigs in programs.items():
+                merged.setdefault(name, set()).update(sigs)
+            doc["programs"] = {name: sorted(sigs)
+                               for name, sigs in sorted(merged.items())}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        return doc
+
     def set_tracer(self, tracer) -> None:
         """Swap the tracer in post-construction (e.g. a traced replay on
         an already-warmed server in ``bench.py --trace``)."""

@@ -90,6 +90,31 @@ def abstract_signature(args: tuple, kwargs: Dict[str, Any]) -> str:
     return "(" + ", ".join(parts) + ")"
 
 
+def _manifest_one(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(map(str, shape))}]"
+    if isinstance(x, (dict, list, tuple)):
+        return "*"
+    return repr(x)
+
+
+def manifest_signature(args: tuple, kwargs: Dict[str, Any]) -> str:
+    """The warmup-manifest rendering of one watched call: top-level
+    only — arrays as ``dtype[d1,d2]``, pytree containers as ``*``,
+    python scalars (static_argnums operands here) by ``repr``.
+
+    This grammar is the runtime twin of
+    ``deepspeed_tpu.analysis.absdomain.expand_signatures``; graftcheck
+    diffs the two sets byte-for-byte, so any change here must be
+    mirrored there (pinned by tests/unit/analysis/test_signatures.py).
+    """
+    parts = [_manifest_one(a) for a in args]
+    parts += [f"{k}={_manifest_one(v)}" for k, v in sorted(kwargs.items())]
+    return "(" + ", ".join(parts) + ")"
+
+
 def _fast_one(x: Any) -> str:
     shape = getattr(x, "shape", None)
     if shape is not None:
@@ -165,9 +190,16 @@ class _WatchedJit:
         # ProgramCostModel instances accounting flops/bytes per call
         # (telemetry/costs.py); weak so dead servers drop off
         self._cost_models: "weakref.WeakSet" = weakref.WeakSet()
+        # warmup signature manifest: every distinct manifest_signature
+        # seen while recording (warmup); end_warmup() freezes it and the
+        # frozen set is the runtime witness graftcheck diffs against
+        self._manifest: set = set()
+        self._recording = True
         _ensure_listener()
 
     def __call__(self, *args, **kwargs):
+        if self._recording:
+            self._manifest.add(manifest_signature(args, kwargs))
         start = _compile_events
         out = self._fn(*args, **kwargs)
         if _compile_events > start and self._watchers:
@@ -265,6 +297,9 @@ class RecompileWatchdog:
         self._raised_at = 0
         self.backend_compiles = 0
         self.events: List[Dict[str, Any]] = []
+        # proxies this watchdog attached: the source of the warmup
+        # signature manifest (weak — shared proxies outlive no owner)
+        self._proxies: "weakref.WeakSet[_WatchedJit]" = weakref.WeakSet()
         _active_watchdogs.add(self)
         _ensure_listener()
 
@@ -283,6 +318,7 @@ class RecompileWatchdog:
                 fn, name or f"{type(owner).__name__}.{attr}")
             setattr(owner, attr, proxy)
         proxy._watchers.add(self)
+        self._proxies.add(proxy)
         if self.cost_model is not None:
             proxy._cost_models.add(self.cost_model)
         return proxy
@@ -323,6 +359,21 @@ class RecompileWatchdog:
     # -- lifecycle -----------------------------------------------------
     def end_warmup(self) -> None:
         self._warmed = True
+        # freeze the warmup manifest: signatures seen from here on are
+        # post-warmup traffic, which the static checker must already
+        # cover via the warmup set (that is the invariant under test)
+        for p in list(self._proxies):
+            p._recording = False
+
+    def signature_manifest(self) -> Dict[str, List[str]]:
+        """program name → sorted warmup signatures, across every proxy
+        this watchdog attached (the runtime half of the graftcheck
+        manifest diff)."""
+        out: Dict[str, set] = {}
+        for p in list(self._proxies):
+            if p._manifest:  # a never-dispatched proxy has no warmup set
+                out.setdefault(p._name, set()).update(p._manifest)
+        return {name: sorted(sigs) for name, sigs in sorted(out.items())}
 
     @property
     def warmed(self) -> bool:

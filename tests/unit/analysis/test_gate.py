@@ -10,12 +10,16 @@ import subprocess
 import sys
 
 import deepspeed_tpu
-from deepspeed_tpu.analysis import ALL_RULES, analyze_paths
+from deepspeed_tpu.analysis import (ALL_RULES, CHECK_RULE_IDS,
+                                    SHARDING_RULES, analyze_paths,
+                                    check_paths)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(
     deepspeed_tpu.__file__)))
 GATE_PATHS = [os.path.join(REPO, "deepspeed_tpu", "serving"),
-              os.path.join(REPO, "deepspeed_tpu", "telemetry")]
+              os.path.join(REPO, "deepspeed_tpu", "telemetry"),
+              os.path.join(REPO, "deepspeed_tpu", "parallel"),
+              os.path.join(REPO, "deepspeed_tpu", "runtime", "engine.py")]
 
 
 def test_gate_zero_unsuppressed_errors():
@@ -43,6 +47,52 @@ def test_gate_runs_every_rule():
     assert {r.id for r in ALL_RULES} == {
         "recompile-hazard", "uncommitted-buffer", "donation-after-use",
         "unsafe-scatter", "hot-loop-host-sync"}
+    assert {r.id for r in SHARDING_RULES} == {
+        "mesh-axis-unknown", "shard-indivisible",
+        "donation-alias-mismatch", "placement-mix"}
+    assert CHECK_RULE_IDS == {r.id for r in SHARDING_RULES} | {
+        "signature-escape", "unbounded-signature"}
+
+
+def test_check_tier_gate_zero_unsuppressed_errors():
+    """The --check tier (lint + sharding + signature enumeration) over
+    the full gate holds at zero unsuppressed errors too."""
+    rep = check_paths(GATE_PATHS, root=REPO)
+    offenders = [f.format_human() for f in rep.findings
+                 if f.counts_as_error]
+    assert rep.errors == 0, (
+        "graftcheck gate broken — fix the finding or add a reasoned "
+        "pragma:\n" + "\n".join(offenders))
+    assert rep.warnings == 0, [f.format_human() for f in rep.findings
+                               if f.severity == "warning"]
+
+
+def test_check_cli_under_two_seconds_without_jax():
+    """`bin/graftlint --check` is the CI entry point: exit 0, < 2 s,
+    and the standalone loader must never pull in jax."""
+    import time
+
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "graftlint"),
+         "--check"],
+        capture_output=True, text=True, timeout=60,
+        cwd=str(REPO))
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert wall < 2.0, f"--check took {wall:.2f}s (budget 2s)"
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import runpy, sys\n"
+         "sys.argv = ['graftlint', '--check']\n"
+         "try:\n"
+         "    runpy.run_path(%r, run_name='__main__')\n"
+         "except SystemExit as e:\n"
+         "    assert e.code == 0, e.code\n"
+         "assert 'jax' not in sys.modules, 'graftlint imported jax'\n"
+         % os.path.join(REPO, "bin", "graftlint")],
+        capture_output=True, text=True, timeout=60, cwd=str(REPO))
+    assert probe.returncode == 0, probe.stdout + probe.stderr
 
 
 def test_cli_json_schema_and_exit_code():

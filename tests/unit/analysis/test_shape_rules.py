@@ -1,0 +1,153 @@
+"""Per-op fixtures for graftcheck's abstract shape/dtype transfer
+functions: each rule must produce the exact abstract result shape for
+its op (gather/take, scatter/dynamic-update-slice, concatenate,
+reshape, broadcast), and symbolic dims must flow through arithmetic
+without collapsing to Unbounded.  Pure absdomain values in, no jax."""
+
+from deepspeed_tpu.analysis.absdomain import (HOST, UNCOMMITTED, Arr,
+                                              FiniteSet, IntRange, Known,
+                                              Scalar, Tup, Unbounded,
+                                              Unknown, pow2_buckets)
+from deepspeed_tpu.analysis.shape_rules import (RULES, binop,
+                                                broadcast_shapes,
+                                                method_call)
+
+
+def _dims(shape):
+    return tuple(d.values() for d in shape)
+
+
+# ------------------------------------------------------------- gather
+def test_take_along_axis_adopts_index_shape():
+    x = Arr((Known(8), Known(256)), "float32", HOST)
+    idx = Arr((Known(8), Known(1)), "int32", HOST)
+    out = RULES["jnp.take_along_axis"]([x, idx], {})
+    assert isinstance(out, Arr)
+    assert _dims(out.shape) == ((8,), (1,)) and out.dtype == "float32"
+
+
+def test_take_with_axis_splices_index_shape():
+    x = Arr((Known(4), Known(32), Known(64)), "float32", HOST)
+    idx = Arr((Known(5),), "int32", HOST)
+    out = RULES["jnp.take"]([x, idx], {"axis": Scalar(1)})
+    assert isinstance(out, Arr)
+    assert _dims(out.shape) == ((4,), (5,), (64,))
+
+
+def test_take_symbolic_axis_escapes_to_unknown():
+    x = Arr((Known(4), Known(32)), "float32", HOST)
+    idx = Arr((Known(5),), "int32", HOST)
+    out = RULES["jnp.take"]([x, idx], {"axis": Scalar(Unbounded("n"))})
+    assert isinstance(out, Unknown)
+
+
+# ------------------------------------- scatter / dynamic update slice
+def test_dynamic_update_slice_keeps_destination_shape():
+    dst = Arr((Known(8), Known(1024)), "int32", HOST)
+    upd = Arr((Known(1), IntRange(16, 256)), "int32", HOST)
+    out = RULES["jax.lax.dynamic_update_slice"](
+        [dst, upd, Scalar(0), Scalar(Unbounded("pos"))], {})
+    assert out is dst  # scatter result == destination, symbolic or not
+
+
+def test_dynamic_slice_in_dim_replaces_one_axis():
+    x = Arr((Known(8), Known(1024)), "float32", HOST)
+    out = RULES["jax.lax.dynamic_slice_in_dim"](
+        [x, Scalar(Unbounded("start")), Scalar(Known(256)), Scalar(1)], {})
+    assert isinstance(out, Arr)
+    assert _dims(out.shape) == ((8,), (256,))
+    # an unbounded SIZE flows through as an Unbounded dim — it only
+    # becomes a finding if the value reaches a watched jit operand
+    out2 = RULES["jax.lax.dynamic_slice_in_dim"](
+        [x, Scalar(0), Scalar(Unbounded("n")), Scalar(1)], {})
+    assert isinstance(out2, Arr)
+    assert isinstance(out2.shape[1], Unbounded)
+
+
+# -------------------------------------------------------- concatenate
+def test_concatenate_sums_known_axis():
+    a = Arr((Known(96),), "int32", HOST)
+    b = Arr((Known(32),), "int32", HOST)
+    out = RULES["np.concatenate"]([Tup([a, b])], {})
+    assert isinstance(out, Arr) and _dims(out.shape) == ((128,),)
+
+
+def test_concatenate_symbolic_part_goes_unbounded_not_wrong():
+    a = Arr((Known(96),), "int32", HOST)
+    b = Arr((IntRange(8, 32),), "int32", HOST)
+    out = RULES["np.concatenate"]([Tup([a, b])], {})
+    assert isinstance(out, Arr)
+    assert isinstance(out.shape[0], Unbounded)  # honest imprecision
+
+
+# ------------------------------------------------- reshape/broadcast
+def test_reshape_with_literal_shape_and_wildcard():
+    x = Arr((Known(4), Known(8)), "float32", HOST)
+    out = RULES["jnp.reshape"]([x, Tup([Scalar(2), Scalar(16)])], {})
+    assert isinstance(out, Arr) and _dims(out.shape) == ((2,), (16,))
+    out2 = RULES["jnp.reshape"]([x, Tup([Scalar(-1), Scalar(8)])], {})
+    assert isinstance(out2, Arr) and _dims(out2.shape) == ((4,), (8,))
+
+
+def test_reshape_wildcard_over_symbolic_operand_is_unknown():
+    x = Arr((IntRange(16, 256),), "float32", HOST)
+    out = RULES["jnp.reshape"]([x, Tup([Scalar(-1), Scalar(8)])], {})
+    assert isinstance(out, Unknown)
+
+
+def test_broadcast_to_adopts_target_shape():
+    x = Arr((Known(1),), "float32", UNCOMMITTED)
+    out = RULES["jnp.broadcast_to"]([x, Tup([Scalar(8), Scalar(4)])], {})
+    assert isinstance(out, Arr) and _dims(out.shape) == ((8,), (4,))
+    assert out.placement == UNCOMMITTED
+
+
+def test_broadcast_shapes_symbolic_dim_survives():
+    w = pow2_buckets(16, 256)
+    out = broadcast_shapes((Known(1), w), (Known(8), Known(1)))
+    assert out[0].values() == (8,)
+    assert out[1] is w  # the SAME Dim object: joint expansion preserved
+
+
+def test_binop_correlates_via_shared_dim_object():
+    b = FiniteSet([1, 2, 4], "B")
+    x = Arr((b, Known(1)), "float32", HOST)
+    y = Arr((b, Known(1)), "float32", HOST)
+    out = binop(x, y)
+    assert isinstance(out, Arr) and out.shape[0] is b
+
+
+# --------------------------------------------------- constructors etc.
+def test_constructors_pin_placement_and_dtype():
+    z = RULES["np.zeros"]([Tup([Scalar(8)])], {})
+    assert z.placement == HOST and z.dtype == "float64"
+    j = RULES["jnp.zeros"]([Tup([Scalar(8)])],
+                           {"dtype": Scalar("int32")})
+    assert j.placement == UNCOMMITTED and j.dtype == "int32"
+    f = RULES["np.full"]([Tup([Scalar(IntRange(16, 32))]), Scalar(7)], {})
+    assert isinstance(f, Arr) and f.dtype == "int64"
+    assert f.shape[0].values() == tuple(range(16, 33))
+
+
+def test_asarray_preserves_placement_astype_preserves_shape():
+    host = Arr((Known(8),), "float64", HOST)
+    out = RULES["jnp.asarray"]([host], {"dtype": Scalar("int32")})
+    assert out.placement == HOST and out.dtype == "int32"  # no commit
+    m = method_call(host, "astype", [Scalar("int32")], {})
+    assert isinstance(m, Arr) and m.dtype == "int32"
+    assert _dims(m.shape) == ((8,),)
+
+
+def test_item_and_tolist_are_host_escapes():
+    x = Arr((), "int32", HOST)
+    assert isinstance(method_call(x, "item", [], {}), Unknown)
+    assert isinstance(method_call(x, "tolist", [], {}), Unknown)
+
+
+# ------------------------------------------------ symbolic arithmetic
+def test_symbolic_dim_value_sets():
+    assert pow2_buckets(16, 256).values() == (16, 32, 64, 128, 256)
+    assert IntRange(2, 5).values() == (2, 3, 4, 5)
+    assert IntRange(1, 10_000).values() is None  # over the 512 cap
+    assert Unbounded("n").values() is None
+    assert FiniteSet([4, 2, 2]).values() == (2, 4)

@@ -1,0 +1,203 @@
+"""Fire/silent fixtures for the four graftcheck sharding rules.  Every
+rule gets its seeded violation AND the paired known-false-positive
+shape from the real codebase (runtime axis sizes, dynamic axis names,
+lambda donation wrappers, numpy-neutral operands) so FP regressions
+break loudly here instead of breaking the --check gate."""
+
+from deepspeed_tpu.analysis import analyze_source
+from deepspeed_tpu.analysis.sharding_rules import SHARDING_RULES
+
+
+def _errors(src, rule, path="<memory>"):
+    out = [f for f in analyze_source(src, path, SHARDING_RULES)
+           if f.severity == "error" and not f.suppressed]
+    return [f for f in out if f.rule == rule]
+
+
+# ---------------------------------------------------- mesh-axis-unknown
+def test_mesh_axis_typo_fires(tmp_path):
+    src = (
+        "from jax.sharding import PartitionSpec\n"
+        "MODEL_AXIS = 'model'\n"
+        "DATA_AXIS = 'data'\n"
+        "spec = PartitionSpec('data', 'modell')\n")
+    p = str(tmp_path / "mod.py")
+    (f,) = _errors(src, "mesh-axis-unknown", p)
+    assert "modell" in f.message and "model" in f.message
+
+
+def test_mesh_axis_declared_and_const_ref_silent(tmp_path):
+    src = (
+        "from jax.sharding import PartitionSpec\n"
+        "MODEL_AXIS = 'model'\n"
+        "a = PartitionSpec(None, 'model')\n"
+        "b = PartitionSpec(MODEL_AXIS)\n"
+        "c = PartitionSpec(('model', MODEL_AXIS))\n")
+    p = str(tmp_path / "mod.py")
+    assert _errors(src, "mesh-axis-unknown", p) == []
+
+
+def test_mesh_axis_dynamic_name_and_no_universe_silent(tmp_path):
+    # known-FP shapes: an axis name held in a runtime variable cannot be
+    # validated, and a module with NO statically-declared mesh anywhere
+    # must not guess
+    src_dyn = (
+        "from jax.sharding import PartitionSpec\n"
+        "DATA_AXIS = 'data'\n"
+        "def make(axis):\n"
+        "    return PartitionSpec(axis)\n")
+    src_none = (
+        "from jax.sharding import PartitionSpec\n"
+        "spec = PartitionSpec('anything')\n")
+    p = str(tmp_path / "mod.py")
+    assert _errors(src_dyn, "mesh-axis-unknown", p) == []
+    assert _errors(src_none, "mesh-axis-unknown", p) == []
+
+
+def test_mesh_axis_project_universe_applies_inside_repo():
+    # analyzed at the real repo path, the universe comes from
+    # deepspeed_tpu/parallel/mesh.py — no module-local decls needed
+    src = (
+        "from jax.sharding import PartitionSpec\n"
+        "spec = PartitionSpec('modle')\n")
+    (f,) = _errors(src, "mesh-axis-unknown",
+                   "deepspeed_tpu/parallel/fixture.py")
+    assert "modle" in f.message
+
+
+# ---------------------------------------------------- shard-indivisible
+def test_shard_indivisible_fires_on_literal_sizes(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import NamedSharding, PartitionSpec\n"
+        "MODEL_AXIS = 'model'\n"
+        "def setup(mesh_cfg):\n"
+        "    mesh = initialize_mesh(model=4)\n"
+        "    x = jnp.zeros((8, 130))\n"
+        "    return jax.device_put(\n"
+        "        x, NamedSharding(mesh, PartitionSpec(None, 'model')))\n")
+    p = str(tmp_path / "mod.py")
+    (f,) = _errors(src, "shard-indivisible", p)
+    assert "130 % 4" in f.message
+
+
+def test_shard_divisible_and_runtime_sizes_silent(tmp_path):
+    ok = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import NamedSharding, PartitionSpec\n"
+        "MODEL_AXIS = 'model'\n"
+        "def setup():\n"
+        "    mesh = initialize_mesh(model=4)\n"
+        "    x = jnp.zeros((8, 128))\n"
+        "    return jax.device_put(\n"
+        "        x, NamedSharding(mesh, PartitionSpec(None, 'model')))\n")
+    # known-FP shape: the axis size is the runtime device count — the
+    # rule must stay silent rather than guess a size
+    runtime = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import NamedSharding, PartitionSpec\n"
+        "MODEL_AXIS = 'model'\n"
+        "def setup(n):\n"
+        "    mesh = initialize_mesh(model=n)\n"
+        "    x = jnp.zeros((8, 130))\n"
+        "    return jax.device_put(\n"
+        "        x, NamedSharding(mesh, PartitionSpec(None, 'model')))\n")
+    p = str(tmp_path / "mod.py")
+    assert _errors(ok, "shard-indivisible", p) == []
+    assert _errors(runtime, "shard-indivisible", p) == []
+
+
+# ----------------------------------------------- donation-alias-mismatch
+def test_donation_never_reaches_output_fires(tmp_path):
+    src = (
+        "import jax\n"
+        "def apply(state, grads):\n"
+        "    return grads * 2\n"
+        "step = jax.jit(apply, donate_argnums=(0,))\n")
+    p = str(tmp_path / "mod.py")
+    (f,) = _errors(src, "donation-alias-mismatch", p)
+    assert "`state`" in f.message
+
+
+def test_donation_flows_through_assignment_chain_silent(tmp_path):
+    src = (
+        "import jax\n"
+        "def apply(state, grads):\n"
+        "    new = state - grads\n"
+        "    out = new * 2\n"
+        "    return out\n"
+        "step = jax.jit(apply, donate_argnums=(0,))\n")
+    p = str(tmp_path / "mod.py")
+    assert _errors(src, "donation-alias-mismatch", p) == []
+
+
+def test_donation_lambda_wrapper_silent(tmp_path):
+    # known-FP shape: a lambda body is an expression, not a Return
+    # statement — the taint must still be seen reaching the result
+    src = (
+        "import jax\n"
+        "step = jax.jit(lambda state, g: update(state, g),\n"
+        "               donate_argnums=(0,))\n")
+    p = str(tmp_path / "mod.py")
+    assert _errors(src, "donation-alias-mismatch", p) == []
+
+
+# ---------------------------------------------------------- placement-mix
+def test_placement_mix_in_traced_fn_fires(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    a = jax.device_put(x)\n"
+        "    b = jnp.zeros((8,))\n"
+        "    return a + b\n"
+        "g = jax.jit(f)\n")
+    p = str(tmp_path / "mod.py")
+    (f,) = _errors(src, "placement-mix", p)
+    assert "committed" in f.message
+
+
+def test_placement_mix_numpy_neutral_and_untraced_silent(tmp_path):
+    # known-FP shape: numpy operands adopt the committed layout — no mix
+    neutral = (
+        "import jax\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    a = jax.device_put(x)\n"
+        "    c = np.zeros((8,))\n"
+        "    return a + c\n"
+        "g = jax.jit(f)\n")
+    # same mix OUTSIDE traced code: host setup is allowed to stage
+    untraced = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def setup(x):\n"
+        "    a = jax.device_put(x)\n"
+        "    b = jnp.zeros((8,))\n"
+        "    return a + b\n")
+    p = str(tmp_path / "mod.py")
+    assert _errors(neutral, "placement-mix", p) == []
+    assert _errors(untraced, "placement-mix", p) == []
+
+
+# ------------------------------------------------- cross-tier pragmas
+def test_check_tier_pragma_not_stale_in_lint_run():
+    """A `# graftlint: allow[placement-mix]` pragma must not trip
+    unused-pragma when only the lint tier runs (the rule id belongs to
+    the --check tier, which did not execute)."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    # graftlint: allow[placement-mix] -- staged on purpose\n"
+        "    return jax.device_put(x) + jnp.zeros((8,))\n"
+        "g = jax.jit(f)\n")
+    lint_only = analyze_source(src)  # default: ALL_RULES, no sharding
+    assert [f for f in lint_only if f.rule == "unused-pragma"] == []
+    # and in a check run the same pragma suppresses the finding
+    check = analyze_source(src, "<memory>", SHARDING_RULES)
+    mixes = [f for f in check if f.rule == "placement-mix"]
+    assert mixes and all(f.suppressed for f in mixes)

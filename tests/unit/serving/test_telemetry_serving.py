@@ -278,3 +278,61 @@ def test_tracer_overhead_is_bounded(stack):
     base = min(run(None), run(None))
     traced = min(run(Tracer()), run(Tracer()))
     assert traced < base * 2 + 0.05
+
+
+def test_warmup_manifest_records_then_freezes(stack):
+    """The watchdog's signature manifest collects every watched call's
+    manifest signature during warmup, freezes at end_warmup, and
+    renders in the exact grammar graftcheck's static enumeration
+    emits (pinned byte-for-byte in tests/unit/analysis)."""
+    _, _, engine = stack
+    rng = np.random.default_rng(23)
+    srv = ServingEngine(engine, num_slots=2, max_queue_depth=16)
+    srv.submit(rng.integers(0, 64, size=6).astype(np.int32),
+               max_new_tokens=3)
+    srv.run_until_drained(max_steps=50)
+    man = srv.watchdog.signature_manifest()
+    assert any(k.startswith("InferenceEngine.") for k in man)
+    flat = [s for sigs in man.values() for s in sigs]
+    assert flat and all(s.startswith("(") and s.endswith(")")
+                        for s in flat)
+    # the 6-token prompt pads to the minimum 16-wide prefill bucket
+    assert any("int32[1,16]" in s
+               for s in man.get("InferenceEngine._jit_prefill_at", []))
+
+    srv.end_warmup()
+    srv.submit(rng.integers(0, 64, size=6).astype(np.int32),
+               max_new_tokens=3)  # same bucket: no recompile, no record
+    srv.run_until_drained(max_steps=50)
+    assert srv.watchdog.signature_manifest() == man  # frozen
+
+
+def test_export_signatures_merges_by_union(stack, tmp_path):
+    # watchdog proxies are shared per ENGINE (attach is idempotent), so
+    # a merged union of distinct warmup sets needs two engines — exactly
+    # the bench shape, where every arm exports into one signatures.json
+    model, params, engine = stack
+    rng = np.random.default_rng(29)
+    path = str(tmp_path / "signatures.json")
+
+    def serve(eng, n_tok):
+        srv = ServingEngine(eng, num_slots=2, max_queue_depth=16)
+        srv.submit(rng.integers(0, 64, size=n_tok).astype(np.int32),
+                   max_new_tokens=2)
+        srv.run_until_drained(max_steps=50)
+        srv.end_warmup()
+        return srv
+
+    doc1 = serve(engine, 6).export_signatures(path)
+    assert doc1["version"] == 1 and len(doc1["configs"]) == 1
+    engine2 = ds.init_inference(model=model, model_parameters=params,
+                                config={"dtype": "float32"})
+    doc2 = serve(engine2, 20).export_signatures(
+        path, merge=True, extra={"max_prompt_len": 20})
+    # identical env dicts dedupe; the extra key makes this one distinct
+    assert len(doc2["configs"]) == 2
+    pre = doc2["programs"]["InferenceEngine._jit_prefill_at"]
+    assert any("int32[1,16]" in s for s in pre)   # first engine's bucket
+    assert any("int32[1,32]" in s for s in pre)   # second engine's bucket
+    on_disk = json.loads(open(path).read())
+    assert on_disk == doc2

@@ -269,6 +269,80 @@ class TestEfficiencyGates:
                     "detail.efficiency.mfuu").returncode == 2
 
 
+class TestSignatureGate:
+    """--signatures-json / --require-signature-match: the graftcheck
+    absolute gate — static enumeration must equal the runtime warmup
+    manifest byte-for-byte, in both directions."""
+
+    @staticmethod
+    def _static_doc():
+        from deepspeed_tpu.analysis import (default_check_envs,
+                                            enumerate_union)
+        envs = default_check_envs()
+        res = enumerate_union(envs, str(REPO))
+        return {"version": 1, "configs": envs,
+                "programs": {k: sorted(v)
+                             for k, v in res.programs.items()}}
+
+    def test_signature_match_passes(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 1.0})
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        man = _write(tmp_path, "signatures.json", self._static_doc())
+        r = _run(base, cand, "--signatures-json", man,
+                 "--require-signature-match")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "signatures [graftcheck]" in r.stdout
+
+    def test_signature_divergence_fails_both_directions(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 1.0})
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        doc = self._static_doc()
+        # runtime manifest MISSING a statically-reachable signature:
+        # that shape was never warmed and will compile post-warmup
+        lean = {**doc, "programs": {
+            k: (v[:-1] if k == "InferenceEngine._jit_decode" else v)
+            for k, v in doc["programs"].items()}}
+        man = _write(tmp_path, "lean.json", lean)
+        r = _run(base, cand, "--signatures-json", man,
+                 "--require-signature-match")
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout
+        # runtime manifest with a signature the static set MISSED:
+        # the checker lost coverage
+        fat = {**doc, "programs": dict(
+            doc["programs"],
+            **{"InferenceEngine._jit_decode":
+               doc["programs"]["InferenceEngine._jit_decode"]
+               + ["(int32[99,99])"]})}
+        man2 = _write(tmp_path, "fat.json", fat)
+        r2 = _run(base, cand, "--signatures-json", man2,
+                  "--require-signature-match")
+        assert r2.returncode == 1
+        assert "(int32[99,99])" in r2.stdout
+
+    def test_gate_flag_without_manifest_exits_2(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 1.0})
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        r = _run(base, cand, "--require-signature-match")
+        assert r.returncode == 2
+        assert "--signatures-json" in r.stderr
+
+    def test_malformed_manifest_exits_2(self, tmp_path):
+        base = _write(tmp_path, "base.json", {"value": 1.0})
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        man = _write(tmp_path, "notman.json", {"hello": 1})
+        r = _run(base, cand, "--signatures-json", man,
+                 "--require-signature-match")
+        assert r.returncode == 2
+        assert "signatures.json" in r.stderr
+
+    def test_bench_signatures_flag_wired(self):
+        src = (REPO / "bench.py").read_text()
+        assert "--signatures" in src
+        assert "_SIGNATURES_PATH" in src
+        assert src.count("export_signatures") >= 4  # both rows, both arms
+
+
 class TestBenchEntryPoints:
     def test_serving_stall_entry_wired(self):
         # arg parsing only: the row itself runs in the serving tests'
