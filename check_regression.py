@@ -55,6 +55,15 @@ are absolute caps on the candidate alone, like ``--max-recompiles`` —
 an unobservable server and a heavyweight observer are defects, not
 noise.
 
+The ``serving-async`` row combines the three absolute gates — its
+``detail.efficiency.goodput_slo`` is the TOP priority class's goodput,
+measured through the real HTTP/SSE front end while the bottom class is
+actively shed by burn-rate control::
+
+    python check_regression.py BENCH_serving_async.base.json \
+        BENCH_serving_async.json \
+        --min-goodput 0.95 --require-zero-leaks --max-recompiles 0
+
 ``--max-lint-errors N`` gates on static trace-safety debt: it reads a
 ``bin/graftlint --json`` report named by ``--lint-json FILE`` and
 requires ``summary.errors`` (unsuppressed, unbaselined graftlint

@@ -175,7 +175,21 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
     ``False``, an int capacity, a kwargs dict, or a
     :class:`telemetry.FlightRecorder`), and ``dump_dir`` (where fatal
     raises drop their post-mortem JSON; ``srv.debug_dump()`` serves the
-    same snapshot live)."""
+    same snapshot live).
+
+    The multi-tenant front-end keys (server-global): ``priority``
+    (``True`` for the default interactive/standard/batch classes, a
+    :class:`serving.PriorityConfig` kwargs dict — ``classes``,
+    ``shares``, ``default_class``, ``tenants`` — or an instance; swaps
+    the FIFO scheduler for :class:`serving.PriorityScheduler` with
+    fair-share token budgets, per-tenant rate limits/quotas, and
+    burn-rate-driven shedding/preemption when ``slo`` is also on) and
+    ``clock`` (a monotonic ``() -> float`` callable shared by EVERY
+    time-dependent decision — deadlines, queue expiry, SLO latencies,
+    rate buckets; defaults to ``time.perf_counter``; never wall
+    clock). Per-request ``priority`` / ``tenant`` ride on ``submit()``.
+    The HTTP/SSE server wraps the returned engine:
+    ``serving.ServingFrontend(srv, port=...)``."""
     from .serving.engine import ServingEngine
 
     serve_keys = ("policy", "do_sample", "temperature", "top_k", "top_p",
@@ -186,7 +200,7 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
                   "guard_numerics", "degradation",
                   "preempt_queue_threshold", "preempt_min_run_steps",
                   "fault_injector", "paged_kv", "cost_model", "slo",
-                  "flight_recorder", "dump_dir")
+                  "flight_recorder", "dump_dir", "priority", "clock")
     serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
     engine = init_inference(model=model, config=config, **kwargs)
     return ServingEngine(engine, num_slots=num_slots,

@@ -8,9 +8,11 @@ iteration-level scheduling with per-request SLO metrics
 (:mod:`.engine`, :mod:`.metrics`), optional draft–verify speculative
 decoding over the same fixed shapes (:mod:`.spec_decode`), the
 fault-tolerance layer — deadlines, preemption, graceful degradation,
-deterministic fault injection (:mod:`.resilience`) — and paged KV with
+deterministic fault injection (:mod:`.resilience`) — paged KV with
 refcounted copy-on-write prefix caching (:mod:`.paged_pool`,
-:mod:`.prefix_cache`; ``paged_kv=True``).
+:mod:`.prefix_cache`; ``paged_kv=True``), and the async network front
+end — HTTP/SSE server, step-thread bridge, priority/tenant scheduling
+(:mod:`.frontend`; ``priority=True``).
 Entry point: ``deepspeed_tpu.init_serving(...)`` or
 :class:`ServingEngine` directly.
 """
@@ -28,6 +30,9 @@ from .scheduler import FIFOScheduler  # noqa: F401
 from .slot_pool import SlotPool  # noqa: F401
 from .spec_decode import (  # noqa: F401
     Drafter, NGramDrafter, SmallModelDrafter, SpecDecodeConfig)
+from .frontend import (AsyncEngineBridge, PriorityConfig,  # noqa: F401
+                       PriorityScheduler, ServingFrontend, TenantPolicy,
+                       TokenStream)
 
 __all__ = ["ServingEngine", "ServingMetrics", "Request", "RequestState",
            "FinishReason", "RejectReason", "FIFOScheduler", "SlotPool",
@@ -35,4 +40,6 @@ __all__ = ["ServingEngine", "ServingMetrics", "Request", "RequestState",
            "SpecDecodeConfig", "Drafter", "NGramDrafter",
            "SmallModelDrafter", "DegradationConfig", "FaultInjector",
            "InjectedFault", "InvariantViolation", "LoadState",
-           "ServingStalledError"]
+           "ServingStalledError", "AsyncEngineBridge", "TokenStream",
+           "PriorityScheduler", "PriorityConfig", "TenantPolicy",
+           "ServingFrontend"]

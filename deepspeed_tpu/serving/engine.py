@@ -150,8 +150,18 @@ class ServingEngine:
                  cost_model: Any = False,
                  slo: Any = None,
                  flight_recorder: Any = True,
-                 dump_dir: Optional[str] = None):
+                 dump_dir: Optional[str] = None,
+                 priority: Any = None,
+                 clock: Optional[Any] = None):
         self.engine = engine
+        # ONE monotonic clock for every time-dependent decision —
+        # deadline stamps, queue expiry, SLO latencies, degradation
+        # cooldowns AND the front end's rate buckets all read this
+        # callable. Injectable so tests drive a fake clock through all
+        # of them at once, and so the front end can share it; wall-clock
+        # time.time() must never leak into deadline paths (NTP steps
+        # would fire or defer deadlines arbitrarily).
+        self._now = clock if clock is not None else time.perf_counter
         # materialize params + jits before sizing anything off the module
         engine._ensure_params(jnp.zeros((1, 2), jnp.int32))
         spec = engine.kv_cache_spec()
@@ -215,8 +225,8 @@ class ServingEngine:
                 # inside the allocation, so the dynamic-slice writes can
                 # never clamp into another request's live columns.
                 sched_capacity = self.pool.capacity - sc.k
-        self.scheduler = FIFOScheduler(
-            num_slots, max_queue_depth, policy=policy,
+        sched_kw = dict(
+            max_queue_depth=max_queue_depth, policy=policy,
             capacity=sched_capacity,
             # page-denominated admission (oversubscription makes row
             # capacity a fiction): reject what the whole pool could
@@ -225,6 +235,18 @@ class ServingEngine:
             page_size=self.pool.page_size if self._paged else None,
             num_pages=self.pool.num_pages if self._paged else None,
             page_headroom=(self._spec.k if self._spec is not None else 0))
+        # priority: None/False (plain FIFO), True (default classes), a
+        # PriorityConfig kwargs dict, or an instance. Imported lazily:
+        # frontend/ imports serving modules, so a top-level import here
+        # would be circular.
+        if priority:
+            from .frontend.priority import PriorityScheduler
+            self.scheduler = PriorityScheduler(
+                num_slots, priority=priority, clock=self._now, **sched_kw)
+        else:
+            self.scheduler = FIFOScheduler(num_slots, **sched_kw)
+        self._priority = getattr(self.scheduler, "config", None) \
+            if priority else None
         # -- telemetry -------------------------------------------------
         # the tracer defaults to DISABLED: span() then costs one branch
         # + a shared null span, keeping the instrumented hot path within
@@ -362,7 +384,6 @@ class ServingEngine:
         self._slot_req: dict = {}                      # slot -> Request
         self._current = np.zeros((num_slots,), np.int32)  # last token per slot
         self._next_id = 0
-        self._now = time.perf_counter
         self._ensure_watch()
         log_dist(f"ServingEngine: slots={num_slots} policy={policy} "
                  f"capacity={self.pool.capacity} "
@@ -664,13 +685,23 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> Request:
+               deadline_ms: Optional[float] = None,
+               priority: Optional[str] = None,
+               tenant: Optional[str] = None) -> Request:
         """Enqueue one generation request. Never raises on load: admission
         control marks the returned request ``REJECTED`` with a
-        ``reject_reason`` (``"queue_full"``, ``"prompt_too_long"``, or
-        ``"retry_after"`` when overload shedding is active — then
-        ``req.retry_after_s`` carries the backoff hint) so callers can
-        shed or retry.
+        ``reject_reason`` (``"queue_full"``, ``"prompt_too_long"``,
+        ``"rate_limited"``/``"tenant_quota"`` under tenant policies, or
+        ``"retry_after"`` when overload or burn-rate shedding is active
+        — then ``req.retry_after_s`` carries the backoff hint) so
+        callers can shed or retry.
+
+        ``priority``/``tenant`` (priority scheduling only) pick the
+        request's class and rate-limit bucket; an unknown class raises
+        ``ValueError``. Burn-rate shedding: when a class's SLO burn
+        alert is at warn/page, submissions of STRICTLY LOWER classes are
+        shed with ``retry_after`` — the error budget of a paying tier is
+        defended by refusing work that would preempt it anyway.
 
         ``deadline_ms`` (or the engine-wide ``deadline_default_ms``)
         arms a TTL from submission: a request that can't finish in time
@@ -683,6 +714,16 @@ class ServingEngine:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         req = Request(self._next_id, prompt, max_new_tokens, eos_token_id)
         self._next_id += 1
+        if self._priority is not None:
+            req.priority_class = (priority if priority is not None
+                                  else self._priority.default_class)
+            self.scheduler.rank_of(req.priority_class)  # loud on unknown
+        elif priority is not None:
+            raise ValueError("priority classes require a priority-enabled "
+                             "engine (pass priority=True/config to "
+                             "ServingEngine / init_serving)")
+        if tenant is not None:
+            req.tenant = str(tenant)
         req.submit_time = self._now()
         ttl = deadline_ms if deadline_ms is not None \
             else self.deadline_default_ms
@@ -697,11 +738,19 @@ class ServingEngine:
             # accepted request that will blow its deadline anyway
             accepted, reason = False, RejectReason.RETRY_AFTER
             req.retry_after_s = self._degradation.retry_after_s
+        elif self._shed_by_burn(req):
+            accepted, reason = False, RejectReason.RETRY_AFTER
+            if req.retry_after_s is None:
+                req.retry_after_s = (
+                    self._degradation.retry_after_s
+                    if self._degradation is not None else 1.0)
         else:
             accepted, reason = self.scheduler.submit(req)
         self.timelines.record(req.request_id, "submitted",
                               prompt_len=req.prompt_len,
-                              max_new_tokens=max_new_tokens)
+                              max_new_tokens=max_new_tokens,
+                              priority_class=req.priority_class,
+                              tenant=req.tenant)
         if not accepted:
             req.state = RequestState.REJECTED
             req.reject_reason = reason
@@ -712,8 +761,31 @@ class ServingEngine:
         elif self.slo is not None:
             # goodput denominator: every ADMITTED request counts against
             # the window, whether or not it ever finishes in time
-            self.slo.observe_admitted()
+            self.slo.observe_admitted(cls=req.priority_class)
         return req
+
+    def _shed_floor(self) -> Optional[int]:
+        """The lowest class rank still admitted under burn-rate
+        shedding, or None when nothing is burning (or priority/SLO
+        tracking is off). When class ``k``'s burn alert is warn/page,
+        every class ranked strictly below ``k`` is shed — the floor is
+        the highest-priority burning class's own rank."""
+        if self._priority is None or self.slo is None:
+            return None
+        floor = None
+        for cls, alert in self.slo.class_alerts.items():
+            if alert in ("warn", "page"):
+                try:
+                    k = self.scheduler.rank_of(cls)
+                except ValueError:
+                    continue  # SLO classes need not all be sched classes
+                floor = k if floor is None else min(floor, k)
+        return floor
+
+    def _shed_by_burn(self, req: Request) -> bool:
+        floor = self._shed_floor()
+        return floor is not None \
+            and self.scheduler.rank_of(req.priority_class) > floor
 
     # ------------------------------------------------------------------
     def _sample(self, logits) -> np.ndarray:
@@ -858,7 +930,7 @@ class ServingEngine:
                     r for r in select_victims(
                         list(self._slot_req.values()),
                         n=len(self._slot_req), current_step=self.step_id,
-                        min_run_steps=0)
+                        min_run_steps=0, class_rank=self._class_rank)
                     if r.slot != slot]
                 if not victims:
                     raise
@@ -1135,14 +1207,21 @@ class ServingEngine:
         arrow, and the terminal timeline event."""
         self.metrics.record_finish(req)
         if self.slo is not None:
-            ok = req.finish_reason in (FinishReason.EOS, FinishReason.LENGTH,
-                                       FinishReason.LENGTH_CAP)
-            e2e = (req.finish_time - req.submit_time
-                   if req.finish_time is not None and
-                   req.submit_time is not None else None)
-            self.slo.observe_finish(ttft_s=req.ttft,
-                                    per_token_s=req.per_token_latency,
-                                    e2e_s=e2e, ok=ok)
+            if req.finish_reason is FinishReason.CANCELLED:
+                # a client cancellation is neither good nor bad service:
+                # withdraw the admission instead of judging latencies
+                self.slo.observe_cancel(cls=req.priority_class)
+            else:
+                ok = req.finish_reason in (FinishReason.EOS,
+                                           FinishReason.LENGTH,
+                                           FinishReason.LENGTH_CAP)
+                e2e = (req.finish_time - req.submit_time
+                       if req.finish_time is not None and
+                       req.submit_time is not None else None)
+                self.slo.observe_finish(ttft_s=req.ttft,
+                                        per_token_s=req.per_token_latency,
+                                        e2e_s=e2e, ok=ok,
+                                        cls=req.priority_class)
         self.tracer.flow("f", "req", req.request_id)
         self.timelines.record(req.request_id, "finished", terminal=True,
                               reason=FinishReason.of(req.finish_reason).value,
@@ -1201,6 +1280,41 @@ class ServingEngine:
                          f"(only RUNNING/PREFILLING requests can be "
                          f"preempted)")
 
+    def cancel(self, request_id: int) -> Optional[Request]:
+        """Cancel a request by id — the client hung up or sent
+        ``DELETE /v1/requests/{id}``. A QUEUED request is removed from
+        the admission queue before it ever costs a prefill; a seated
+        (RUNNING/PREFILLING) one is evicted through the preemption
+        rollback (slot released, pages refcount-decremented, prefill
+        queue filtered) and NOT re-queued. Either way the request
+        retires ``FINISHED``/``cancelled`` with a terminal timeline
+        event, and SLO accounting withdraws the admission (cancellation
+        is neither good nor bad service). Returns the request, or None
+        when the id is unknown or already terminal — a cancel racing
+        the final token is normal, not an error."""
+        for r in self.scheduler.queue:
+            if r.request_id == request_id:
+                # identity filter: deque.remove would still work (eq=False
+                # means identity ==), but stay explicit like _evict_slot
+                self.scheduler.queue = type(self.scheduler.queue)(
+                    x for x in self.scheduler.queue if x is not r)
+                return self._finish_cancel(r)
+        for r in list(self._slot_req.values()):
+            if r.request_id == request_id:
+                slot = r.slot
+                self._evict_slot(r)
+                self.tracer.instant("serving/cancel", rid=r.request_id,
+                                    slot=slot)
+                return self._finish_cancel(r)
+        return None
+
+    def _finish_cancel(self, req: Request) -> Request:
+        req.state = RequestState.FINISHED
+        req.finish_reason = FinishReason.CANCELLED
+        req.finish_time = self._now()
+        self._finish_record(req)
+        return req
+
     def _preempt_req(self, req: Request, auto: bool) -> None:
         slot = req.slot
         self._evict_slot(req)
@@ -1237,14 +1351,52 @@ class ServingEngine:
                 or self.scheduler.pending <= self.preempt_queue_threshold):
             return
         starved = self.pool.free_count == 0
-        if not starved and self._paged and self.scheduler.queue:
-            starved = (self._page_cost(self.scheduler.queue[0])
+        head = self.scheduler.head()
+        if not starved and self._paged and head is not None:
+            starved = (self._page_cost(head)
                        > self._grant_page_budget())
         if not starved:
             return
         victims = select_victims(
             list(self._slot_req.values()), n=1, current_step=self.step_id,
-            min_run_steps=self.preempt_min_run_steps)
+            min_run_steps=self.preempt_min_run_steps,
+            class_rank=self._class_rank)
+        for req in victims:
+            self._preempt_req(req, auto=True)
+
+    def _class_rank(self, req: Request) -> int:
+        """Victim-selection key: a request's priority rank (0 = highest)
+        under priority scheduling, 0 for everyone under plain FIFO."""
+        if self._priority is None:
+            return 0
+        return self.scheduler.rank_of(req.priority_class)
+
+    def _burn_preempt(self) -> None:
+        """Burn-rate-driven preemption, the seated half of class
+        shedding: while a class's burn alert is at warn/page
+        (``_shed_floor``), requests of STRICTLY LOWER classes are not
+        just refused at submit — if a protected-class request is
+        waiting and the pool is starved (no free slot, or its pages
+        exceed what a grant could allocate), one shed-class resident is
+        evicted per step (paced like ``_auto_preempt``; tail-requeued so
+        it resumes once the burn clears)."""
+        floor = self._shed_floor()
+        if floor is None:
+            return
+        head = self.scheduler.head_within(floor)
+        if head is None:
+            return  # nobody protected is waiting
+        starved = self.pool.free_count == 0
+        if not starved and self._paged:
+            starved = self._page_cost(head) > self._grant_page_budget()
+        if not starved:
+            return  # normal admission will seat the protected head
+        sheddable = [r for r in self._slot_req.values()
+                     if self._class_rank(r) > floor]
+        victims = select_victims(
+            sheddable, n=1, current_step=self.step_id,
+            min_run_steps=self.preempt_min_run_steps,
+            class_rank=self._class_rank)
         for req in victims:
             self._preempt_req(req, auto=True)
 
@@ -1271,6 +1423,7 @@ class ServingEngine:
             self._expire_deadlines(finished)
             self._update_load_state()
             self._auto_preempt()
+            self._burn_preempt()
             tracer.counter("serving/occupancy", live=self.live_count,
                            pending=self.scheduler.pending)
             with tracer.span("serving/grant"):
@@ -1375,8 +1528,9 @@ class ServingEngine:
         p99 = float(np.percentile(np.asarray(gaps), 99) * 1e3) \
             if gaps else None
         pending = self.scheduler.pending
-        if self._paged and self.scheduler.queue and \
-                self._page_cost(self.scheduler.queue[0]) \
+        head = self.scheduler.head()
+        if self._paged and head is not None and \
+                self._page_cost(head) \
                 > self._grant_page_budget():
             # page starvation is load even when the queue is short: an
             # oversubscribed pool that can't seat the queue head should

@@ -1,0 +1,30 @@
+"""Async serving front end: HTTP/SSE server, engine step-thread bridge,
+and SLO-aware multi-tenant priority scheduling.
+
+Pure host-side code — no jax imports, zero compiled programs (pinned by
+the analysis-tier inventory test): the engine's jitted surface is
+untouched by design, and graftcheck proves the signature set unchanged.
+
+Modules:
+
+* :mod:`.priority` — :class:`PriorityScheduler` (priority classes,
+  fair-share token budgets, per-tenant rate limits/quotas) plus its
+  :class:`PriorityConfig`/:class:`TenantPolicy` knobs.
+* :mod:`.bridge` — :class:`AsyncEngineBridge`, the dedicated step
+  thread + thread-safe op queue + per-request async token streams.
+* :mod:`.server` — :class:`ServingFrontend`, the stdlib-only
+  asyncio HTTP/1.1 + Server-Sent-Events server.
+"""
+
+from .bridge import AsyncEngineBridge, TokenStream
+from .priority import PriorityConfig, PriorityScheduler, TenantPolicy
+from .server import ServingFrontend
+
+__all__ = [
+    "AsyncEngineBridge",
+    "TokenStream",
+    "PriorityConfig",
+    "PriorityScheduler",
+    "TenantPolicy",
+    "ServingFrontend",
+]

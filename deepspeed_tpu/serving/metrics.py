@@ -268,6 +268,8 @@ class ServingMetrics:
             "preempted": self.preempted,
             "deadline_expired": sum(
                 1 for r in done if r.finish_reason == FinishReason.DEADLINE),
+            "cancelled": sum(
+                1 for r in done if r.finish_reason == FinishReason.CANCELLED),
             "step_overruns": self.step_overruns,
             "load_transitions": self.load_transitions,
             "new_tokens": new_tokens,

@@ -52,6 +52,7 @@ class FinishReason(str, enum.Enum):
     LENGTH = "length"                    # hit max_new_tokens
     LENGTH_CAP = "length_cap"            # cache row full (capacity)
     DEADLINE = "deadline"                # per-request deadline expired
+    CANCELLED = "cancelled"              # client cancelled / disconnected
     ERROR = "error"                      # mid-step engine exception
     NUMERICAL_ERROR = "numerical_error"  # NaN/inf logits in this slot
 
@@ -68,8 +69,11 @@ class RejectReason(str, enum.Enum):
 
     QUEUE_FULL = "queue_full"            # bounded queue at depth
     PROMPT_TOO_LONG = "prompt_too_long"  # can never fit the KV capacity
-    RETRY_AFTER = "retry_after"          # shed by overload degradation;
+    RETRY_AFTER = "retry_after"          # shed by overload degradation or
+    #                                      burn-rate class shedding;
     #                                      retry_after_s carries the hint
+    RATE_LIMITED = "rate_limited"        # tenant token bucket empty
+    TENANT_QUOTA = "tenant_quota"        # tenant queue quota reached
 
     __str__ = str.__str__
 
@@ -95,6 +99,11 @@ class Request:
     prompt: np.ndarray                      # (T,) int32
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+
+    # -- multi-tenancy --------------------------------------------------
+    priority_class: str = "default"         # scheduling class; rank order
+    #                                         comes from PriorityConfig
+    tenant: str = "default"                 # rate-limit / quota bucket
 
     state: RequestState = RequestState.QUEUED
     reject_reason: Optional[RejectReason] = None

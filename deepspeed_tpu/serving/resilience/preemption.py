@@ -17,7 +17,7 @@ admission thrash that generates zero tokens.
 
 from __future__ import annotations
 
-from typing import Iterable, List
+from typing import Callable, Iterable, List, Optional
 
 from ..request import Request, RequestState
 
@@ -28,20 +28,29 @@ PREEMPTABLE_STATES = (RequestState.RUNNING, RequestState.PREFILLING)
 
 def select_victims(candidates: Iterable[Request], n: int = 1,
                    current_step: int = 0,
-                   min_run_steps: int = 2) -> List[Request]:
+                   min_run_steps: int = 2,
+                   class_rank: Optional[Callable[[Request], int]] = None,
+                   ) -> List[Request]:
     """Rank preemption candidates youngest/lowest-progress first and
     return up to ``n`` eligible victims.
 
     ``candidates`` are seated requests (RUNNING or PREFILLING);
     anything else is skipped. Eligibility additionally requires the
     request to have held its slot for at least ``min_run_steps``
-    scheduler steps (``current_step - last_admit_step``)."""
+    scheduler steps (``current_step - last_admit_step``).
+
+    With ``class_rank`` (priority scheduling; maps a request to its
+    class rank, 0 = highest priority), the LOWEST class is victimized
+    first — rank dominates the sunk-work tiebreak, so an interactive
+    request is never bounced while a batch request holds a slot."""
     eligible = [
         r for r in candidates
         if r.state in PREEMPTABLE_STATES
         and (current_step - r.last_admit_step) >= min_run_steps]
-    # fewest generated tokens first (least sunk work), then most recent
-    # admission, then newest request id — a total, deterministic order
-    eligible.sort(key=lambda r: (len(r.output_tokens), -r.last_admit_step,
-                                 -r.request_id))
+    # lowest priority class first (when ranked), then fewest generated
+    # tokens (least sunk work), then most recent admission, then newest
+    # request id — a total, deterministic order
+    rank = class_rank if class_rank is not None else (lambda r: 0)
+    eligible.sort(key=lambda r: (-rank(r), len(r.output_tokens),
+                                 -r.last_admit_step, -r.request_id))
     return eligible[:max(n, 0)]

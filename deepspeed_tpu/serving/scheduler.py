@@ -71,6 +71,15 @@ class FIFOScheduler:
     def pending(self) -> int:
         return len(self.queue)
 
+    def head(self) -> Optional[Request]:
+        """The request the next ``grant`` would pop first, or None.
+
+        The engine peeks at this (never at ``queue[0]`` directly) for
+        starvation/pressure decisions, so subclasses with a different
+        grant order (priority scheduling) redefine "head" in one place.
+        """
+        return self.queue[0] if self.queue else None
+
     # ------------------------------------------------------------------
     def submit(self, req: Request) -> Tuple[bool, Optional[RejectReason]]:
         """Admission control. Returns ``(accepted, reject_reason)``;
@@ -144,10 +153,19 @@ class FIFOScheduler:
         budget and the FIFO head blocks further grants when it no longer
         fits — per-step prefill work is bounded by tokens, not by how
         many slots happen to be free. ``spent`` is prefill work the
-        caller already committed this step (an in-flight chunk);
-        liveness guard: when NOTHING has been spent or granted yet, the
-        head is granted even if its cost alone exceeds the budget
-        (bounded overshoot beats a permanently stuck queue).
+        caller already committed this step (an in-flight chunk).
+
+        HEAD-LIVENESS GUARANTEE (pinned by regression tests, relied on
+        by the priority scheduler): when NOTHING has been spent or
+        granted yet this step, the head is granted even if its cost
+        alone exceeds the budget (bounded overshoot beats a permanently
+        stuck queue). Consequence: on any step where a slot is free and
+        no prefill work was already committed, the next-to-pop request
+        makes progress — no token budget, however small, can livelock
+        the queue. ``PriorityScheduler`` preserves exactly this property
+        for its highest-ranked waiter, which is how the lowest class
+        still makes progress when higher classes are idle: it IS the
+        highest-ranked waiter then.
 
         With ``page_budget``/``page_cost`` (paged KV), each pop is also
         charged ``page_cost(req)`` fresh pages (its uncached prefix).

@@ -21,7 +21,10 @@ SLO*, not raw throughput. The sensors for that live here:
   (last 2 windows) and long (all windows) horizon. Alert state is
   ``page`` when both horizons burn ≥ ``page_burn``, ``warn`` when both
   ≥ ``warn_burn``, else ``ok`` — requiring both horizons suppresses
-  one-window blips while still paging fast on sustained burn.
+  one-window blips while still paging fast on sustained burn. The same
+  two-horizon formula runs PER PRIORITY CLASS over per-class window
+  rings (``class_alert``) — that is the signal the serving front end's
+  burn-rate-driven shedding/preemption consumes.
 
 Everything exports through the existing sinks: registry gauges (hence
 Prometheus), Perfetto counter tracks, and monitor events on alert
@@ -246,6 +249,13 @@ class SLOTracker:
         self.finished_total = 0
         self.good_total = 0
         self.per_class: Dict[str, List[int]] = {}
+        # per-class windowed counters, same ring layout as _gw, created
+        # lazily per class — these drive the PER-CLASS burn rates the
+        # priority scheduler's shedding/preemption loop consumes
+        self._cw: Dict[str, List[List[int]]] = {}
+        self.class_alerts: Dict[str, str] = {}
+        self.class_burns: Dict[str, List[float]] = {}  # cls -> [short, long]
+        self.cancelled_total = 0
         self.alert_state = "ok"
         self.burn_short = 0.0
         self.burn_long = 0.0
@@ -260,10 +270,36 @@ class SLOTracker:
         return self.config.classes.get(cls) \
             or self.config.classes.get("default") or SLOTargets()
 
+    def _class_window(self, cls: str) -> List[List[int]]:
+        cw = self._cw.get(cls)
+        if cw is None:
+            cw = self._cw[cls] = [[0, 0]
+                                  for _ in range(self.config.windows)]
+        return cw
+
     def observe_admitted(self, cls: str = "default") -> None:
         self.admitted_total += 1
         self._gw[self._gw_cur][0] += 1
         self.per_class.setdefault(cls, [0, 0, 0])[0] += 1
+        self._class_window(cls)[self._gw_cur][0] += 1
+
+    def observe_cancel(self, cls: str = "default") -> None:
+        """Un-admit a cancelled request: client cancellation (or
+        disconnect) is neither good nor bad service, so it must not
+        move goodput either way. The admitted counters are decremented
+        where the admission still sits; if the admitting window has
+        already rotated out, the decrement lands in the current window
+        instead — a bounded, self-correcting artifact (each such cancel
+        offsets at most one admission of the same class, and windows
+        are short relative to request lifetimes)."""
+        self.cancelled_total += 1
+        self._gw[self._gw_cur][0] = max(0, self._gw[self._gw_cur][0] - 1)
+        if self.admitted_total > 0:
+            self.admitted_total -= 1
+        pc = self.per_class.setdefault(cls, [0, 0, 0])
+        pc[0] = max(0, pc[0] - 1)
+        cw = self._class_window(cls)[self._gw_cur]
+        cw[0] = max(0, cw[0] - 1)
 
     def observe_gap(self, gap_s: float) -> None:
         t0 = time.perf_counter_ns()
@@ -300,6 +336,10 @@ class SLOTracker:
             self.good_total += 1
             self._gw[self._gw_cur][1] += 1
             pc[2] += 1
+            self._class_window(cls)[self._gw_cur][1] += 1
+        else:
+            self._class_window(cls)  # materialize the ring so the class
+            #                          shows up in burn/alert maps
         self.overhead_ns += time.perf_counter_ns() - t0
         return within
 
@@ -318,22 +358,35 @@ class SLOTracker:
         budget = max(1e-9, 1.0 - self.config.goodput_target)
         return max(0.0, 1.0 - goodput) / budget
 
+    def _alert_of(self, burn_short: float, burn_long: float) -> str:
+        cfg = self.config
+        if burn_short >= cfg.page_burn and burn_long >= cfg.page_burn:
+            return "page"
+        if burn_short >= cfg.warn_burn and burn_long >= cfg.warn_burn:
+            return "warn"
+        return "ok"
+
     def _recompute_alert(self) -> None:
         cfg = self.config
-        prev = self._gw[(self._gw_cur - 1) % cfg.windows]
+        prev_i = (self._gw_cur - 1) % cfg.windows
         self.burn_short = self._burn(
-            self._goodput_of([self._gw[self._gw_cur], prev]))
+            self._goodput_of([self._gw[self._gw_cur], self._gw[prev_i]]))
         self.burn_long = self._burn(self.goodput())
-        if self.burn_short >= cfg.page_burn \
-                and self.burn_long >= cfg.page_burn:
-            state = "page"
-        elif self.burn_short >= cfg.warn_burn \
-                and self.burn_long >= cfg.warn_burn:
-            state = "warn"
-        else:
-            state = "ok"
+        state = self._alert_of(self.burn_short, self.burn_long)
         self._last_state_change = state != self.alert_state
         self.alert_state = state
+        # per-class burns, same two-horizon formula over the class rings
+        for cls, cw in self._cw.items():
+            short = self._burn(
+                self._goodput_of([cw[self._gw_cur], cw[prev_i]]))
+            long = self._burn(self._goodput_of(cw))
+            self.class_burns[cls] = [short, long]
+            self.class_alerts[cls] = self._alert_of(short, long)
+
+    def class_alert(self, cls: str) -> str:
+        """Current burn-rate alert for one class (``ok`` when the class
+        has never been observed)."""
+        return self.class_alerts.get(cls, "ok")
 
     def _rotate(self) -> None:
         self.ttft.rotate()
@@ -341,6 +394,8 @@ class SLOTracker:
         self.e2e.rotate()
         self._gw_cur = (self._gw_cur + 1) % self.config.windows
         self._gw[self._gw_cur] = [0, 0]
+        for cw in self._cw.values():
+            cw[self._gw_cur] = [0, 0]
         self.rotations += 1
         self._steps_in_window = 0
         # quantile walks are O(buckets x windows); amortize them to
@@ -409,6 +464,10 @@ class SLOTracker:
         self.finished_total = 0
         self.good_total = 0
         self.per_class = {}
+        self._cw = {}
+        self.class_alerts = {}
+        self.class_burns = {}
+        self.cancelled_total = 0
         self.alert_state = "ok"
         self.burn_short = 0.0
         self.burn_long = 0.0
@@ -439,9 +498,15 @@ class SLOTracker:
             "gap_p90_ms": gap.quantile(0.9),
             "gap_p99_ms": gap.quantile(0.99),
             "e2e_p99_ms": e2e.quantile(0.99),
-            "per_class": {k: {"admitted": v[0], "finished": v[1],
-                              "good": v[2]}
-                          for k, v in sorted(self.per_class.items())},
+            "cancelled": self.cancelled_total,
+            "per_class": {
+                k: {"admitted": v[0], "finished": v[1], "good": v[2],
+                    "goodput_window": (self._goodput_of(self._cw[k])
+                                       if k in self._cw else 1.0),
+                    "burn_short": self.class_burns.get(k, [0.0, 0.0])[0],
+                    "burn_long": self.class_burns.get(k, [0.0, 0.0])[1],
+                    "alert": self.class_alerts.get(k, "ok")}
+                for k, v in sorted(self.per_class.items())},
             "rotations": self.rotations,
             "windows": self.config.windows,
             "window_steps": self.config.window_steps,

@@ -12,7 +12,7 @@ import sys
 import deepspeed_tpu
 from deepspeed_tpu.analysis import (ALL_RULES, CHECK_RULE_IDS,
                                     SHARDING_RULES, analyze_paths,
-                                    check_paths)
+                                    check_paths, iter_python_files)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(
     deepspeed_tpu.__file__)))
@@ -20,6 +20,7 @@ GATE_PATHS = [os.path.join(REPO, "deepspeed_tpu", "serving"),
               os.path.join(REPO, "deepspeed_tpu", "telemetry"),
               os.path.join(REPO, "deepspeed_tpu", "parallel"),
               os.path.join(REPO, "deepspeed_tpu", "runtime", "engine.py")]
+FRONTEND = os.path.join(REPO, "deepspeed_tpu", "serving", "frontend")
 
 
 def test_gate_zero_unsuppressed_errors():
@@ -31,6 +32,30 @@ def test_gate_zero_unsuppressed_errors():
         "pragma:\n" + "\n".join(offenders))
     assert rep.warnings == 0, [f.format_human() for f in rep.findings
                                if f.severity == "warning"]
+
+
+def test_gate_covers_serving_frontend():
+    """The async front end (bridge/server/priority) is inside the
+    serving/ gate path by recursion, but pin it explicitly: the step
+    thread is the one seam where host code touches the engine every
+    step, so hot-loop-host-sync must keep seeing these files — and
+    they must hold at zero findings with zero pragmas (pure host-side
+    code has nothing to suppress)."""
+    rep = analyze_paths([FRONTEND])
+    assert rep.files >= 4, (
+        f"frontend scan saw only {rep.files} files — gate lost "
+        "serving/frontend/")
+    assert rep.errors == 0 and rep.warnings == 0, [
+        f.format_human() for f in rep.findings]
+    assert rep.suppressed == 0, (
+        "frontend should need no pragmas — it must stay pure host "
+        "code:\n" + "\n".join(f.format_human() for f in rep.findings
+                              if f.suppressed))
+    # and the recursive serving/ gate really does include these files
+    gate_files = {f for f in iter_python_files(GATE_PATHS)}
+    frontend_files = set(iter_python_files([FRONTEND]))
+    assert frontend_files <= gate_files, (
+        sorted(frontend_files - gate_files))
 
 
 def test_gate_every_suppression_carries_a_reason():

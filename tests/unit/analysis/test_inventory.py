@@ -14,6 +14,7 @@ from deepspeed_tpu.serving import engine as engine_mod
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(
     deepspeed_tpu.__file__)))
 SERVING = os.path.join(REPO, "deepspeed_tpu", "serving")
+FRONTEND = os.path.join(REPO, "deepspeed_tpu", "serving", "frontend")
 INFERENCE = os.path.join(REPO, "deepspeed_tpu", "inference")
 
 
@@ -53,6 +54,19 @@ def test_inventory_finds_the_known_entry_points():
     # escape the inventory originally caught)
     assert by_attr["_jit_finite"]["class"] == "ServingEngine"
     assert by_attr["_argmax"]["class"] == "SmallModelDrafter"
+
+
+def test_frontend_has_zero_jits():
+    """The async front end is pure host code by design — the engine's
+    compiled surface must not grow when the HTTP/bridge/priority layer
+    lands.  Any jit binding appearing under serving/frontend/ is
+    inventory drift and fails here until it is watch-listed (and the
+    design doc explaining why the front end compiles nothing is
+    updated)."""
+    inv = jit_inventory([FRONTEND])
+    assert inv == [], (
+        f"serving/frontend/ grew jitted entry points: "
+        f"{sorted(e['attr'] for e in inv)}")
 
 
 def test_watched_engine_jits_exist_in_inference_inventory():

@@ -186,6 +186,54 @@ def test_slo_per_class_targets():
     assert t.snapshot()["per_class"]["batch"]["good"] == 1
 
 
+def test_slo_per_class_burn_alerts_are_independent():
+    """One class burning must not page the others — the per-class
+    two-horizon burn drives the priority scheduler's shedding floor, so
+    a batch-tier meltdown paging the interactive tier would shed the
+    wrong traffic."""
+    t = SLOTracker({"ttft_ms": 100.0, "gap_ms": None, "window_steps": 4,
+                    "windows": 4, "goodput_target": 0.9,
+                    "warn_burn": 2.0, "page_burn": 5.0})
+    for step in range(16):
+        t.observe_admitted(cls="interactive")
+        t.observe_finish(ttft_s=9.0, cls="interactive")   # always blown
+        t.observe_admitted(cls="batch")
+        t.observe_finish(ttft_s=0.010, cls="batch")       # always within
+        t.on_step(step)
+    assert t.class_alert("interactive") == "page"
+    assert t.class_alert("batch") == "ok"
+    assert t.class_alert("never_seen") == "ok"
+    short, long = t.class_burns["interactive"]
+    assert short >= 5.0 and long >= 5.0
+    snap = t.snapshot()
+    assert snap["per_class"]["interactive"]["alert"] == "page"
+    assert snap["per_class"]["batch"]["alert"] == "ok"
+    assert snap["per_class"]["batch"]["goodput_window"] == 1.0
+    t.reset()
+    assert t.class_alerts == {} and t.class_burns == {}
+
+
+def test_slo_observe_cancel_is_goodput_neutral():
+    """A cancelled request withdraws its admission: goodput must move
+    neither up (it never finished well) nor down (the client hanging up
+    is not the server's failure)."""
+    t = SLOTracker({"ttft_ms": 100.0, "gap_ms": None})
+    for _ in range(4):
+        t.observe_admitted(cls="interactive")
+    for _ in range(3):
+        t.observe_finish(ttft_s=0.010, cls="interactive")
+    t.observe_cancel(cls="interactive")
+    assert t.goodput() == pytest.approx(1.0)
+    assert t.cancelled_total == 1
+    snap = t.snapshot()
+    assert snap["cancelled"] == 1
+    assert snap["per_class"]["interactive"]["admitted"] == 3
+    # floors at zero even if the admitting window already rotated out
+    t2 = SLOTracker(True)
+    t2.observe_cancel(cls="ghost")
+    assert t2.goodput() == 1.0 and t2.admitted_total == 0
+
+
 # -- FlightRecorder ----------------------------------------------------
 def test_recorder_ring_is_bounded():
     r = FlightRecorder(capacity=8)

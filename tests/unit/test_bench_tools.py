@@ -371,6 +371,48 @@ class TestBenchEntryPoints:
                     "exactly_one"):
             assert key in src
 
+    def test_serving_async_entry_wired(self):
+        # the async front-end row: dispatched BEFORE the plain
+        # "serving" membership check, and emits the exact fields its
+        # three-gate invocation (--min-goodput --require-zero-leaks
+        # --max-recompiles 0) reads
+        src = (REPO / "bench.py").read_text()
+        assert "def serving_async_main" in src
+        assert src.index('"serving-async" in argv') \
+            < src.index('"serving" in argv')
+        for key in ("ServingFrontend", "class_alerts",
+                    "batch_actively_shed", "per_class_http"):
+            assert key in src
+
+    def test_serving_async_gate_combination(self, tmp_path):
+        # the row's driver invocation stacks all three absolute gates;
+        # a synthetic row in the serving-async shape must pass them
+        # together, and each defect must fail alone
+        def row(goodput=1.0, leaks=0, tl=True, recompiles=0):
+            return {"value": goodput, "detail": {
+                "slot_leaks": leaks, "invariants_ok": True,
+                "timelines_complete": tl,
+                "recompiles_after_warmup": recompiles,
+                "efficiency": {"goodput_slo": goodput},
+                "batch_actively_shed": True}}
+
+        gates = ("--min-goodput", "0.95", "--require-zero-leaks",
+                 "--max-recompiles", "0")
+        base = _write(tmp_path, "base.json", row())
+        r = _run(base, _write(tmp_path, "ok.json", row()), *gates)
+        assert r.returncode == 0, r.stdout + r.stderr
+        # top-class goodput below the floor (shedding ate the wrong
+        # tier), a leaked slot, an open timeline, a recompile: each
+        # alone must fail
+        assert _run(base, _write(tmp_path, "gp.json", row(goodput=0.5)),
+                    *gates).returncode == 1
+        assert _run(base, _write(tmp_path, "lk.json", row(leaks=1)),
+                    *gates).returncode == 1
+        assert _run(base, _write(tmp_path, "tl.json", row(tl=False)),
+                    *gates).returncode == 1
+        assert _run(base, _write(tmp_path, "rc.json", row(recompiles=2)),
+                    *gates).returncode == 1
+
     def test_check_regression_importable(self):
         # the module must import without side effects (argparse only
         # runs under __main__) so the driver can vendor it
