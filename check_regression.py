@@ -216,12 +216,16 @@ def main(argv=None) -> int:
                          "on a beyond-threshold move, never exits 1 "
                          "(for machine-dependent metrics like "
                          "detail.efficiency.mfu on CPU)")
-    ap.add_argument("--lint-json", metavar="FILE", default=None,
+    ap.add_argument("--lint-json", metavar="FILE", action="append",
+                    default=None,
                     help="a `bin/graftlint --json` report to gate with "
-                         "--max-lint-errors")
+                         "--max-lint-errors; repeatable, so one run can "
+                         "gate e.g. a lint-tier and a `--tier sync` "
+                         "report together (the cap applies to each "
+                         "report independently)")
     ap.add_argument("--max-lint-errors", type=int, default=None,
                     metavar="N",
-                    help="absolute cap on summary.errors in the "
+                    help="absolute cap on summary.errors in each "
                          "--lint-json report (unsuppressed graftlint "
                          "errors; the serving gate uses 0)")
     ap.add_argument("--signatures-json", metavar="FILE", default=None,
@@ -280,13 +284,15 @@ def main(argv=None) -> int:
             print(f"            {d}")
         failed |= worse
     if args.max_lint_errors is not None:
-        lint = _load(args.lint_json)
-        e = _resolve(lint, "summary.errors", args.lint_json)
-        worse = e > args.max_lint_errors
-        tag = "REGRESSION" if worse else "ok"
-        print(f"{tag:>10}  summary.errors [graftlint] (absolute): "
-              f"candidate={e:g} max={args.max_lint_errors}")
-        failed |= worse
+        for lint_path in args.lint_json:
+            lint = _load(lint_path)
+            e = _resolve(lint, "summary.errors", lint_path)
+            worse = e > args.max_lint_errors
+            tag = "REGRESSION" if worse else "ok"
+            print(f"{tag:>10}  summary.errors [graftlint] (absolute): "
+                  f"candidate={e:g} max={args.max_lint_errors} "
+                  f"({os.path.basename(lint_path)})")
+            failed |= worse
     if args.require_zero_leaks:
         leaks = _resolve(cand, "detail.slot_leaks", args.candidate)
         worse = leaks != 0

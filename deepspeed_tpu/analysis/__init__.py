@@ -13,6 +13,14 @@ turns the repo's hardest-won runtime invariants into CI-time rules:
 * ``hot-loop-host-sync`` — host syncs on device values in
   ``ServingEngine.step``-reachable code
 
+The **graftsync** tier (``--tier sync``, on by default) adds
+thread-context inference over the PR-11 asyncio front end — every
+function is classified LOOP / ENGINE / BOTH (``--threads`` dumps the
+map) — and five async-safety rules on top of it:
+``blocking-call-in-coroutine``, ``cross-thread-engine-access``,
+``unsafe-future-resolution``, ``await-while-holding-lock``, and
+``unguarded-shared-write`` (catalog: :mod:`.concurrency_rules`).
+
 See ``bin/graftlint`` for the CLI and the "Static analysis" section of
 the README for the rule catalog, pragma syntax and baseline workflow.
 Findings are suppressed per line with::
@@ -25,19 +33,24 @@ heavyweight ``deepspeed_tpu`` package import).
 """
 
 from .baseline import load_baseline, write_baseline  # noqa: F401
+from .concurrency import ThreadContextMap  # noqa: F401
+from .concurrency_rules import SYNC_RULE_IDS, SYNC_RULES  # noqa: F401
 from .findings import ERROR, INFO, WARNING, Finding  # noqa: F401
 from .interp import (default_check_envs, diff_manifest,  # noqa: F401
                      enumerate_signatures, enumerate_union)
 from .pragmas import PragmaIndex  # noqa: F401
 from .rules import ALL_RULES, META_RULES, RULES_BY_ID  # noqa: F401
-from .runner import (Report, analyze_paths, analyze_source,  # noqa: F401
-                     check_paths, iter_python_files, jit_inventory)
+from .runner import (DEFAULT_RULES, Report, analyze_paths,  # noqa: F401
+                     analyze_source, check_paths, iter_python_files,
+                     jit_inventory, thread_inventory)
 from .sharding_rules import CHECK_RULE_IDS, SHARDING_RULES  # noqa: F401
 
 __all__ = [
-    "ALL_RULES", "CHECK_RULE_IDS", "META_RULES", "RULES_BY_ID", "ERROR",
-    "WARNING", "INFO", "Finding", "PragmaIndex", "Report", "analyze_paths",
+    "ALL_RULES", "CHECK_RULE_IDS", "DEFAULT_RULES", "META_RULES",
+    "RULES_BY_ID", "SYNC_RULES", "SYNC_RULE_IDS", "ERROR",
+    "WARNING", "INFO", "Finding", "PragmaIndex", "Report",
+    "ThreadContextMap", "analyze_paths",
     "analyze_source", "check_paths", "default_check_envs", "diff_manifest",
     "enumerate_signatures", "enumerate_union", "iter_python_files",
-    "jit_inventory", "load_baseline", "write_baseline",
+    "jit_inventory", "load_baseline", "thread_inventory", "write_baseline",
 ]

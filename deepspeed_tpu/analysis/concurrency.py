@@ -1,0 +1,376 @@
+"""Thread-context inference for graftsync — which thread runs each function?
+
+The PR-11 serving front end split the process into exactly two execution
+contexts: the asyncio **event loop** (every coroutine, every loop
+callback) and the dedicated **engine step thread** (the
+``threading.Thread(target=...)`` body that owns ``ServingEngine.step``).
+The whole design rests on the handoffs between them being explicit — the
+op queue, ``loop.call_soon_threadsafe``, ``loop.run_in_executor`` — so a
+static analyzer can recover the context of every function by seeding the
+obvious anchors and propagating along *direct* calls only.
+
+Like the rest of :mod:`deepspeed_tpu.analysis` this is plain :mod:`ast`
+over one module: no jax, no threading, no execution.
+
+Seeds
+-----
+* ``async def``                            -> LOOP (a coroutine body only
+  ever runs on the loop thread)
+* ``threading.Thread(target=f)``           -> ``f`` is ENGINE
+* method ``step`` of ``class ServingEngine`` -> ENGINE
+* callbacks handed to ``call_soon_threadsafe`` / ``call_soon`` /
+  ``call_later`` / ``add_done_callback``    -> LOOP (asyncio invokes
+  them on the loop thread regardless of who scheduled them)
+* callables handed to ``<...>bridge.call(f)`` -> ENGINE (the op queue is
+  the one sanctioned crossing; the bridge executes ``f`` on the step
+  thread)
+* callables handed to ``run_in_executor``  -> EXECUTOR (a worker thread:
+  exempt from loop-blocking rules, not an engine context)
+
+Propagation
+-----------
+A caller's contexts flow to every callee it invokes *directly* (bare
+name, ``self.method()``, local alias — the same resolution
+:class:`~.dataflow.ModuleIndex` uses for trace propagation).  Passing a
+function as an argument does **not** propagate: a reference crossing a
+queue or a callback API is a handoff, and the seed rules above assign
+the receiving side explicitly.  Calling an ``async def`` merely creates
+a coroutine object, so propagation never flows *into* coroutines either.
+A function reachable from both sides is BOTH and must satisfy the rules
+of each.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .dataflow import FunctionNode, FuncInfo, ModuleIndex, node_path
+
+LOOP = "LOOP"
+ENGINE = "ENGINE"
+
+#: canonical constructor paths (after import-alias normalisation)
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+              "threading.Semaphore", "threading.BoundedSemaphore"}
+QUEUE_CTORS = {"queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+               "queue.PriorityQueue"}
+THREAD_CTORS = {"threading.Thread"}
+CONCURRENT_FUTURE_CTORS = {"concurrent.futures.Future"}
+
+#: event-loop APIs whose callback argument runs on the loop thread;
+#: value = positional index of the callback
+_LOOP_CALLBACK_APIS = {"call_soon_threadsafe": 0, "call_soon": 0,
+                       "call_later": 1, "add_done_callback": 0}
+
+#: engine classes whose ``step`` anchors the step thread
+_ENGINE_STEP_CLASSES = {"ServingEngine"}
+
+
+@dataclass
+class ThreadInfo:
+    """Inferred execution context(s) of one function."""
+    fi: FuncInfo
+    contexts: Set[str] = field(default_factory=set)
+    seeds: List[str] = field(default_factory=list)
+    executor: bool = False
+
+    @property
+    def label(self) -> Optional[str]:
+        if LOOP in self.contexts and ENGINE in self.contexts:
+            return "BOTH"
+        if LOOP in self.contexts:
+            return LOOP
+        if ENGINE in self.contexts:
+            return ENGINE
+        if self.executor:
+            return "EXECUTOR"
+        return None
+
+
+class ThreadContextMap:
+    """LOOP / ENGINE / BOTH classification for every function of a module,
+    plus the module-wide path sets (locks, queues, threads) the sync
+    rules need to recognise guards and handoffs."""
+
+    def __init__(self, index: ModuleIndex):
+        self.index = index
+        self.infos: Dict[ast.AST, ThreadInfo] = {
+            node: ThreadInfo(fi) for node, fi in index.functions.items()}
+        #: dotted paths of threading.Lock()/Condition()/... objects
+        self.lock_paths: Set[str] = set()
+        #: dotted paths of queue.Queue() objects (thread-safe handoff)
+        self.queue_paths: Set[str] = set()
+        #: dotted paths of threading.Thread() objects (``.join`` blocks)
+        self.thread_paths: Set[str] = set()
+        #: dotted paths of concurrent.futures.Future() objects (their
+        #: ``set_result`` IS thread-safe — exempt from the future rule)
+        self.concurrent_future_paths: Set[str] = set()
+        self._alias: Dict[str, str] = {}
+        self._collect_import_aliases()
+        self._collect_infra_paths()
+        self._seed()
+        self._propagate()
+
+    # ------------------------------------------------------------- build
+    def _collect_import_aliases(self) -> None:
+        """Map names as written to canonical dotted paths, so
+        ``import queue as _queue; _queue.Queue()`` still classifies."""
+        for node in ast.walk(self.index.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    if al.asname:
+                        self._alias[al.asname] = al.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod in ("threading", "queue", "asyncio",
+                           "concurrent.futures", "time", "socket"):
+                    for al in node.names:
+                        self._alias[al.asname or al.name] = \
+                            f"{mod}.{al.name}"
+
+    def canonical(self, path: Optional[str]) -> Optional[str]:
+        """Rewrite the leading component of ``path`` through the import
+        aliases (``_queue.Queue`` -> ``queue.Queue``)."""
+        if path is None:
+            return None
+        if path in self._alias:
+            return self._alias[path]
+        head, _, rest = path.partition(".")
+        if head in self._alias:
+            return f"{self._alias[head]}.{rest}" if rest else self._alias[head]
+        return path
+
+    def _collect_infra_paths(self) -> None:
+        for node in ast.walk(self.index.tree):
+            value = None
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, list(node.targets)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            if not isinstance(value, ast.Call):
+                continue
+            ctor = self.canonical(node_path(value.func))
+            if ctor is None:
+                continue
+            if ctor in LOCK_CTORS:
+                dest = self.lock_paths
+            elif ctor in QUEUE_CTORS:
+                dest = self.queue_paths
+            elif ctor in THREAD_CTORS:
+                dest = self.thread_paths
+            elif ctor in CONCURRENT_FUTURE_CTORS:
+                dest = self.concurrent_future_paths
+            else:
+                continue
+            for t in targets:
+                p = node_path(t)
+                if p is not None:
+                    dest.add(p)
+
+    def _seed(self) -> None:
+        for node, info in self.infos.items():
+            if isinstance(node, ast.AsyncFunctionDef):
+                info.contexts.add(LOOP)
+                info.seeds.append("async def")
+            fi = info.fi
+            if isinstance(node, ast.FunctionDef) and \
+                    fi.class_name in _ENGINE_STEP_CLASSES and \
+                    node.name == "step":
+                info.contexts.add(ENGINE)
+                info.seeds.append(f"{fi.class_name}.step")
+
+        # call-site seeds need the enclosing scope/class for resolution
+        outer = self
+
+        class SeedVisitor(ast.NodeVisitor):
+            def __init__(v):
+                v.scope: List[FuncInfo] = []
+                v.cls: List[str] = []
+
+            def visit_ClassDef(v, node):
+                v.cls.append(node.name)
+                v.generic_visit(node)
+                v.cls.pop()
+
+            def _visit_fn(v, node):
+                v.scope.append(outer.index.functions[node])
+                v.generic_visit(node)
+                v.scope.pop()
+
+            visit_FunctionDef = _visit_fn
+            visit_AsyncFunctionDef = _visit_fn
+
+            def visit_Lambda(v, node):
+                v._visit_fn(node)
+
+            def visit_Call(v, node):
+                scope = v.scope[-1] if v.scope else None
+                cls = v.cls[-1] if v.cls else None
+                outer._seed_call(node, scope, cls)
+                v.generic_visit(node)
+
+        SeedVisitor().visit(self.index.tree)
+
+    def _seed_call(self, call: ast.Call, scope: Optional[FuncInfo],
+                   cls: Optional[str]) -> None:
+        func = call.func
+        # threading.Thread(target=f) -> f runs on its own thread: ENGINE
+        if self.canonical(node_path(func)) in THREAD_CTORS:
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    self._seed_ref(kw.value, scope, cls, ENGINE,
+                                   "threading.Thread target")
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        # loop.call_soon_threadsafe(cb, ...) and friends -> cb is LOOP
+        if func.attr in _LOOP_CALLBACK_APIS:
+            idx = _LOOP_CALLBACK_APIS[func.attr]
+            if len(call.args) > idx:
+                self._seed_ref(call.args[idx], scope, cls, LOOP,
+                               f"{func.attr} callback")
+            return
+        # loop.run_in_executor(None, f, ...) -> f runs on a worker thread
+        if func.attr == "run_in_executor":
+            if len(call.args) > 1:
+                fi = self.index._resolve_target(call.args[1], scope, cls)
+                if fi is not None:
+                    info = self.infos[fi.node]
+                    info.executor = True
+                    info.seeds.append("run_in_executor target")
+            return
+        # <...>bridge.call(f) -> the op queue runs f on the step thread
+        if func.attr == "call" and call.args:
+            recv = node_path(func.value)
+            if recv is not None and recv.split(".")[-1].endswith("bridge"):
+                self._seed_ref(call.args[0], scope, cls, ENGINE,
+                               "bridge.call handoff")
+
+    def _seed_ref(self, expr: ast.expr, scope: Optional[FuncInfo],
+                  cls: Optional[str], context: str, why: str) -> None:
+        fi = self.index._resolve_target(expr, scope, cls)
+        if fi is None:
+            return
+        # a coroutine handed to a loop API still runs on the loop; an
+        # async def can never acquire the ENGINE context
+        if context == ENGINE and isinstance(fi.node, ast.AsyncFunctionDef):
+            return
+        info = self.infos[fi.node]
+        if context not in info.contexts:
+            info.contexts.add(context)
+        info.seeds.append(why)
+
+    def _propagate(self) -> None:
+        """Flow each function's contexts to its directly-called callees
+        (bare name / ``self.method()`` / local alias) to a fixpoint."""
+        by_name_module = {fi.node.name: fi
+                          for fi in self.index.functions.values()
+                          if fi.parent is None
+                          and isinstance(fi.node, FunctionNode)}
+        methods: Dict[Tuple[str, str], FuncInfo] = {}
+        for fi in self.index.functions.values():
+            if fi.class_name and isinstance(fi.node, FunctionNode):
+                methods[(fi.class_name, fi.node.name)] = fi
+
+        def callees(fi: FuncInfo) -> List[FuncInfo]:
+            out: List[FuncInfo] = []
+            aliases: Dict[str, FuncInfo] = {}
+            for n in ast.walk(fi.node):
+                if isinstance(n, ast.Assign) and \
+                        isinstance(n.value, (ast.Name, ast.Attribute)):
+                    cal = self.index._resolve_callee(
+                        n.value, fi, aliases, by_name_module, methods)
+                    if cal is not None:
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                aliases[t.id] = cal
+                if isinstance(n, ast.Call):
+                    cal = self.index._resolve_callee(
+                        n.func, fi, aliases, by_name_module, methods)
+                    if cal is not None:
+                        out.append(cal)
+            return out
+
+        frontier = [info.fi for info in self.infos.values()
+                    if info.contexts]
+        while frontier:
+            fi = frontier.pop()
+            ctxs = self.infos[fi.node].contexts
+            for cal in callees(fi):
+                if isinstance(cal.node, ast.AsyncFunctionDef):
+                    continue    # calling a coroutine fn just builds the object
+                tgt = self.infos[cal.node]
+                missing = ctxs - tgt.contexts
+                if missing:
+                    tgt.contexts.update(missing)
+                    frontier.append(cal)
+
+    # ----------------------------------------------------------- queries
+    def info(self, node: ast.AST) -> Optional[ThreadInfo]:
+        return self.infos.get(node)
+
+    def contexts(self, node: ast.AST) -> Set[str]:
+        info = self.infos.get(node)
+        return set(info.contexts) if info is not None else set()
+
+    def loop_functions(self) -> Iterator[ThreadInfo]:
+        """Functions that run on the event loop (including BOTH), minus
+        executor targets — the scope of the loop-blocking rules."""
+        for info in self.infos.values():
+            if LOOP in info.contexts and not info.executor:
+                yield info
+
+    def engine_functions(self) -> Iterator[ThreadInfo]:
+        """Functions that run on the step thread (including BOTH)."""
+        for info in self.infos.values():
+            if ENGINE in info.contexts:
+                yield info
+
+    def labels(self) -> Dict[str, str]:
+        """``qualname -> LOOP|ENGINE|BOTH|EXECUTOR`` for every function
+        with an inferred context, deterministic across runs."""
+        out: Dict[str, str] = {}
+        for node, info in sorted(self.infos.items(),
+                                 key=lambda kv: (kv[1].fi.qualname,
+                                                 kv[0].lineno)):
+            lab = info.label
+            if lab is None:
+                continue
+            key = info.fi.qualname
+            if key in out:        # lambdas can share a qualname
+                key = f"{key}@{node.lineno}"
+            out[key] = lab
+        return out
+
+
+def held_locks_walk(fn_node: ast.AST, lock_paths: Set[str],
+                    canonical=None) -> Iterator[Tuple[ast.AST,
+                                                      Tuple[str, ...]]]:
+    """Yield ``(node, held)`` for every AST node lexically inside
+    ``fn_node`` (not descending into nested functions/classes), where
+    ``held`` is the tuple of lock paths whose ``with`` blocks enclose
+    the node, in acquisition order."""
+    canon = canonical or (lambda p: p)
+
+    def rec(node: ast.AST, held: Tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FunctionNode + (ast.ClassDef, ast.Lambda)):
+                continue
+            yield child, held
+            if isinstance(child, ast.With):
+                acquired = list(held)
+                for item in child.items:
+                    yield from rec(item, tuple(held))
+                    p = canon(node_path(item.context_expr))
+                    if p in lock_paths:
+                        acquired.append(p)
+                for s in child.body:
+                    yield s, tuple(acquired)
+                    yield from rec(s, tuple(acquired))
+            else:
+                yield from rec(child, held)
+
+    yield from rec(fn_node, ())

@@ -1,0 +1,371 @@
+"""graftsync rules — each one enforces a PR-11 front-end design rule.
+
+==========================  ================================================
+rule id                     design rule it enforces (serving/frontend)
+==========================  ================================================
+blocking-call-in-coroutine  "``step()`` must never run on the event loop —
+                            a single decode dispatch would stall every
+                            connection."  Any synchronous sleep / socket /
+                            file / queue / join / device-sync call inside
+                            LOOP context freezes every open connection for
+                            its duration; hand it to a worker via
+                            ``loop.run_in_executor`` or await an async
+                            equivalent.
+cross-thread-engine-access  "``call(fn)`` is the only sanctioned way for
+                            the front end to READ engine state."  The
+                            engine's dicts are mutated mid-step, so a
+                            LOOP-context read or write of
+                            ``ServingEngine``/``Scheduler``/``SlotPool``
+                            state observes torn updates.
+unsafe-future-resolution    "the loop thread delivers" — asyncio futures
+                            are not thread-safe; ``set_result`` /
+                            ``set_exception`` from the step thread must be
+                            marshalled with ``loop.call_soon_threadsafe``
+                            (the bridge's ``_resolve``/``_reject`` shape).
+await-while-holding-lock    a ``threading.Lock`` held across an ``await``
+                            is held for an unbounded number of loop
+                            iterations, and the engine thread contending
+                            for it stalls the batch; also flags
+                            inconsistent lock-acquisition order across
+                            functions (AB/BA deadlock).
+unguarded-shared-write      every LOOP<->ENGINE handoff goes through the
+                            op queue or a lock; an attribute written from
+                            both contexts with neither is a data race
+                            (torn dict iteration, lost update).
+==========================  ================================================
+
+All rules key off :class:`~.concurrency.ThreadContextMap`; a module with
+no seeds (no coroutines, no threads) produces no findings, which keeps
+the tier silent on the non-concurrent 95% of the codebase.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .concurrency import (ENGINE, LOOP, ThreadContextMap, held_locks_walk)
+from .dataflow import FunctionNode, node_path, target_paths
+from .findings import ERROR, Finding
+from .rules import ModuleContext, Rule
+
+#: dotted-path prefixes that denote engine-owned state from the front
+#: end's perspective (ServingEngine / Scheduler / SlotPool live behind
+#: these roots in every module of serving/frontend)
+_ENGINE_ROOTS = ("self.srv", "self.engine", "self._srv", "self._engine",
+                 "srv", "engine")
+
+#: call attributes that hand a callable/reference across the boundary on
+#: purpose — their arguments are exempt from cross-thread access checks
+_HANDOFF_ATTRS = {"call", "run_in_executor", "call_soon_threadsafe",
+                  "call_soon", "call_later", "add_done_callback"}
+
+#: method calls that mutate their receiver in place
+_MUTATORS = {"append", "extend", "insert", "remove", "clear", "pop",
+             "popitem", "update", "add", "discard", "setdefault"}
+
+
+def get_thread_map(ctx: ModuleContext) -> ThreadContextMap:
+    m = getattr(ctx, "_thread_map", None)
+    if m is None:
+        m = ThreadContextMap(ctx.index)
+        ctx._thread_map = m
+    return m
+
+
+def _own_nodes(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Every AST node lexically owned by ``fn_node`` — nested functions,
+    lambdas, and classes are skipped (they are analysed under their own
+    inferred context, which is what makes executor/bridge-handoff bodies
+    naturally exempt here)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, FunctionNode + (ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_engine_root(path: Optional[str]) -> bool:
+    return path in _ENGINE_ROOTS
+
+
+class BlockingCallInCoroutineRule(Rule):
+    id = "blocking-call-in-coroutine"
+    severity = ERROR
+    short = ("synchronous blocking call inside event-loop context "
+             "(stalls every open connection)")
+
+    _SOCKET_ATTRS = {"recv", "recvfrom", "recv_into", "sendall", "accept"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        tmap = get_thread_map(ctx)
+        for info in tmap.loop_functions():
+            nodes = list(_own_nodes(info.fi.node))
+            awaited = {id(n.value) for n in nodes
+                       if isinstance(n, ast.Await)
+                       and isinstance(n.value, ast.Call)}
+            for n in nodes:
+                if not isinstance(n, ast.Call) or id(n) in awaited:
+                    continue
+                msg = self._blocking_reason(tmap, n)
+                if msg is not None:
+                    yield self.finding(ctx, n, msg, info.fi.qualname)
+
+    def _blocking_reason(self, tmap: ThreadContextMap,
+                         call: ast.Call) -> Optional[str]:
+        path = tmap.canonical(node_path(call.func))
+        if path == "time.sleep":
+            return ("time.sleep blocks the event loop — every open "
+                    "connection stalls; use `await asyncio.sleep(...)`")
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            return ("synchronous file I/O on the event loop — hand it to "
+                    "a worker via `await loop.run_in_executor(...)`")
+        if path == "jax.block_until_ready":
+            return ("jax.block_until_ready is a device sync — it parks "
+                    "the loop for a full dispatch; run it on the step "
+                    "thread via `bridge.call`")
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        recv = node_path(call.func.value)
+        if attr in self._SOCKET_ATTRS:
+            return (f"synchronous socket .{attr}() on the event loop — "
+                    "use the asyncio stream APIs (`await reader.read`, "
+                    "`await writer.drain`)")
+        if attr == "block_until_ready":
+            return ("`.block_until_ready()` is a device sync — run it on "
+                    "the step thread via `bridge.call`")
+        if attr == "step" and recv is not None and \
+                recv.split(".")[-1].lstrip("_") in ("srv", "engine"):
+            return (f"direct `{recv}.step()` on the event loop — a decode "
+                    "dispatch stalls every connection; the step thread "
+                    "owns step() (submit work through the bridge)")
+        if attr == "join" and recv in tmap.thread_paths:
+            return (f"`{recv}.join()` blocks the loop until the thread "
+                    "exits — wrap it: `await loop.run_in_executor(None, "
+                    f"{recv}.join)`")
+        if attr == "get" and recv in tmap.queue_paths:
+            for kw in call.keywords:
+                if kw.arg == "block" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is False:
+                    return None
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and call.args[0].value is False:
+                return None
+            return (f"blocking `{recv}.get()` on the event loop — use "
+                    "get_nowait() or an asyncio.Queue on this side of "
+                    "the boundary")
+        return None
+
+
+class CrossThreadEngineAccessRule(Rule):
+    id = "cross-thread-engine-access"
+    severity = ERROR
+    short = ("event-loop code touches engine state directly instead of "
+             "going through bridge.call")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        tmap = get_thread_map(ctx)
+        for info in tmap.loop_functions():
+            nodes = list(_own_nodes(info.fi.node))
+            handoff_args: Set[int] = set()
+            for n in nodes:
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _HANDOFF_ATTRS:
+                    for arg in list(n.args) + [kw.value
+                                               for kw in n.keywords]:
+                        handoff_args.update(id(x) for x in ast.walk(arg))
+            for n in nodes:
+                if not isinstance(n, ast.Attribute) or id(n) in handoff_args:
+                    continue
+                # flag the first deref step past an engine root — one
+                # finding per chain, and the bare reference (a handoff)
+                # stays legal
+                if not _is_engine_root(node_path(n.value)):
+                    continue
+                if n.attr == "step":
+                    continue   # blocking-call-in-coroutine owns step()
+                root = node_path(n.value)
+                yield self.finding(
+                    ctx, n,
+                    f"LOOP-context access to engine state "
+                    f"`{root}.{n.attr}` — the engine is single-threaded "
+                    "on the step thread and its dicts are mutated "
+                    "mid-step; read it via `await bridge.call(lambda "
+                    "srv: ...)`", info.fi.qualname)
+
+
+class UnsafeFutureResolutionRule(Rule):
+    id = "unsafe-future-resolution"
+    severity = ERROR
+    short = ("asyncio future resolved off-loop without "
+             "call_soon_threadsafe")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        tmap = get_thread_map(ctx)
+        for info in tmap.engine_functions():
+            conc = set(tmap.concurrent_future_paths)
+            conc.update(self._concurrent_params(info.fi.node))
+            for n in _own_nodes(info.fi.node):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("set_result", "set_exception")):
+                    continue
+                recv = node_path(n.func.value)
+                if recv is not None and recv in conc:
+                    continue   # concurrent.futures.Future IS thread-safe
+                yield self.finding(
+                    ctx, n,
+                    f"`{recv or '<expr>'}.{n.func.attr}()` runs on the "
+                    "step thread — asyncio futures are not thread-safe; "
+                    "marshal it: `loop.call_soon_threadsafe(...)`",
+                    info.fi.qualname)
+
+    @staticmethod
+    def _concurrent_params(fn_node: ast.AST) -> Iterator[str]:
+        args = getattr(fn_node, "args", None)
+        if args is None:
+            return
+        for a in list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs):
+            if a.annotation is None:
+                continue
+            try:
+                text = ast.unparse(a.annotation)
+            except Exception:      # pragma: no cover - malformed annotation
+                continue
+            if "concurrent" in text:
+                yield a.arg
+
+
+class AwaitWhileHoldingLockRule(Rule):
+    id = "await-while-holding-lock"
+    severity = ERROR
+    short = ("await inside a threading.Lock `with` block, or AB/BA lock "
+             "order across functions")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        tmap = get_thread_map(ctx)
+        if not tmap.lock_paths:
+            return
+        #: (outer, inner) -> (line, qualname) of first acquisition site
+        orders: Dict[Tuple[str, str], Tuple[int, str, ast.AST]] = {}
+        for node, fi in ctx.index.functions.items():
+            if not isinstance(node, FunctionNode):
+                continue
+            for sub, held in held_locks_walk(node, tmap.lock_paths,
+                                             tmap.canonical):
+                if isinstance(sub, ast.Await) and held:
+                    yield self.finding(
+                        ctx, sub,
+                        f"`await` while holding threading lock "
+                        f"`{held[-1]}` — the lock stays held across an "
+                        "unbounded suspension and the engine thread "
+                        "contending for it stalls the batch; release "
+                        "before awaiting (or use asyncio.Lock)",
+                        fi.qualname)
+                if isinstance(sub, ast.With):
+                    inner_held = list(held)
+                    for item in sub.items:
+                        p = tmap.canonical(node_path(item.context_expr))
+                        if p not in tmap.lock_paths:
+                            continue
+                        for outer in inner_held:
+                            if outer != p:
+                                orders.setdefault(
+                                    (outer, p),
+                                    (sub.lineno, fi.qualname, sub))
+                        inner_held.append(p)
+        reported: Set[frozenset] = set()
+        for (a, b), (line, qual, node) in sorted(
+                orders.items(), key=lambda kv: kv[1][0]):
+            rev = orders.get((b, a))
+            if rev is None or frozenset((a, b)) in reported:
+                continue
+            reported.add(frozenset((a, b)))
+            later = (line, qual, node) if line >= rev[0] else rev
+            other = rev if later is not rev else (line, qual, node)
+            yield self.finding(
+                ctx, later[2],
+                f"inconsistent lock order: `{b if later[0] == line else a}`"
+                f" is acquired while holding the other lock here, but "
+                f"{other[1]} (line {other[0]}) acquires them in the "
+                "opposite order — classic AB/BA deadlock",
+                later[1])
+
+
+class UnguardedSharedWriteRule(Rule):
+    id = "unguarded-shared-write"
+    severity = ERROR
+    short = ("attribute written from both LOOP and ENGINE contexts with "
+             "no lock on at least one side")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        tmap = get_thread_map(ctx)
+        #: (class, attr) -> side -> [(line, qualname, guarded, node)]
+        sites: Dict[Tuple[str, str], Dict[str, List]] = {}
+        for node, fi in ctx.index.functions.items():
+            if not isinstance(node, FunctionNode) or not fi.class_name:
+                continue
+            ctxs = tmap.contexts(node)
+            if not ctxs:
+                continue
+            for sub, held in held_locks_walk(node, tmap.lock_paths,
+                                             tmap.canonical):
+                for path in self._written_paths(sub):
+                    if not path.startswith("self.") or path.count(".") != 1:
+                        continue
+                    if path in tmap.queue_paths:
+                        continue   # the queue IS the sanctioned handoff
+                    attr = path.split(".", 1)[1]
+                    rec = sites.setdefault((fi.class_name, attr),
+                                           {LOOP: [], ENGINE: []})
+                    for side in (LOOP, ENGINE):
+                        if side in ctxs:
+                            rec[side].append((sub.lineno, fi.qualname,
+                                              bool(held), sub))
+        for (cls, attr), rec in sorted(sites.items()):
+            loop_sites, engine_sites = rec[LOOP], rec[ENGINE]
+            if not loop_sites or not engine_sites:
+                continue
+            unguarded = [s for s in loop_sites + engine_sites if not s[2]]
+            if not unguarded:
+                continue
+            anchor = next((s for s in loop_sites if not s[2]), unguarded[0])
+            loop_lines = sorted({s[0] for s in loop_sites})
+            eng_lines = sorted({s[0] for s in engine_sites})
+            yield self.finding(
+                ctx, anchor[3],
+                f"`self.{attr}` is written from both LOOP (line "
+                f"{', '.join(map(str, loop_lines))}) and ENGINE (line "
+                f"{', '.join(map(str, eng_lines))}) contexts without a "
+                "lock — serialize one side through the op queue or guard "
+                "both sides with one lock", anchor[1])
+
+    @staticmethod
+    def _written_paths(node: ast.AST) -> Iterator[str]:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                yield from target_paths(t)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            yield from target_paths(node.target)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                yield from target_paths(t)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            p = node_path(node.func.value)
+            if p is not None:
+                yield p
+
+
+SYNC_RULES = (BlockingCallInCoroutineRule(), CrossThreadEngineAccessRule(),
+              UnsafeFutureResolutionRule(), AwaitWhileHoldingLockRule(),
+              UnguardedSharedWriteRule())
+
+SYNC_RULE_IDS = {r.id for r in SYNC_RULES}

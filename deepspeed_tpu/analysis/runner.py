@@ -16,12 +16,19 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence
 
 from .baseline import apply_baseline, load_baseline
+from .concurrency import ThreadContextMap
+from .concurrency_rules import SYNC_RULES
 from .dataflow import ModuleIndex
 from .findings import ERROR, WARNING, Finding, assign_fingerprints
 from .pragmas import PragmaIndex
 from .rules import ALL_RULES, ModuleContext, Rule
 
 SCHEMA_VERSION = 1
+
+#: the default ("all tiers") rule set: trace-safety lints + the
+#: graftsync thread-context rules.  Sharding rules and the abstract
+#: interpreter join via ``check_paths`` (they need project context).
+DEFAULT_RULES = tuple(ALL_RULES) + tuple(SYNC_RULES)
 
 
 @dataclass
@@ -119,7 +126,7 @@ def analyze_source(source: str, path: str = "<memory>",
     the rule findings *before* pragma application so ``allow[...]``
     comments and fingerprints treat them like any rule output.
     """
-    rules = list(rules) if rules is not None else list(ALL_RULES)
+    rules = list(rules) if rules is not None else list(DEFAULT_RULES)
     findings: List[Finding] = list(extra_findings or [])
     try:
         tree = ast.parse(source, filename=path)
@@ -175,8 +182,9 @@ def analyze_source(source: str, path: str = "<memory>",
 def analyze_paths(paths: Sequence[str],
                   select: Optional[Iterable[str]] = None,
                   ignore: Optional[Iterable[str]] = None,
-                  baseline: Optional[str] = None) -> Report:
-    rules: List[Rule] = list(ALL_RULES)
+                  baseline: Optional[str] = None,
+                  rules: Optional[Sequence[Rule]] = None) -> Report:
+    rules = list(rules) if rules is not None else list(DEFAULT_RULES)
     if select:
         chosen = set(select)
         rules = [r for r in rules if r.id in chosen]
@@ -214,10 +222,9 @@ def check_paths(paths: Sequence[str],
     static/runtime divergence is a CI diff, not a source finding.
     """
     from .interp import default_check_envs, enumerate_union
-    from .rules import ALL_RULES as _LINT_RULES
     from .sharding_rules import SHARDING_RULES
 
-    rules: List[Rule] = list(_LINT_RULES) + list(SHARDING_RULES)
+    rules: List[Rule] = list(DEFAULT_RULES) + list(SHARDING_RULES)
     if select:
         chosen = set(select)
         rules = [r for r in rules if r.id in chosen]
@@ -259,6 +266,25 @@ def check_paths(paths: Sequence[str],
     if baseline:
         apply_baseline(report.findings, load_baseline(baseline))
     return report
+
+
+def thread_inventory(paths: Sequence[str]) -> Dict[str, Dict[str, str]]:
+    """The inferred thread-context map (graftsync's ``--threads`` dump):
+    ``relpath -> {qualname: LOOP|ENGINE|BOTH|EXECUTOR}`` for every
+    function with a context, deterministic across runs — the input to
+    the thread-context drift test."""
+    out: Dict[str, Dict[str, str]] = {}
+    for fp in iter_python_files(paths):
+        with open(fp, encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=fp)
+        except SyntaxError:
+            continue
+        labels = ThreadContextMap(ModuleIndex(tree)).labels()
+        if labels:
+            out[_relpath(fp)] = labels
+    return out
 
 
 def jit_inventory(paths: Sequence[str]) -> List[Dict[str, object]]:

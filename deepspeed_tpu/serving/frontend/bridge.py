@@ -108,6 +108,7 @@ class AsyncEngineBridge:
         if self.running:
             raise RuntimeError("bridge already started")
         self._loop = asyncio.get_running_loop()
+        # graftlint: allow[unguarded-shared-write] -- written before Thread.start(), whose happens-before edge publishes them; only _apply_op writes them afterwards
         self._stopping = self._draining = False
         self._thread = threading.Thread(
             target=self._run, name="serving-step", daemon=True)
@@ -124,10 +125,16 @@ class AsyncEngineBridge:
         await asyncio.get_running_loop().run_in_executor(
             None, self._thread.join)
         self._thread = None
+        # ops that raced the shutdown decision (enqueued after the step
+        # thread's final queue drain) must fail fast, not hang their
+        # awaiting coroutines; nothing services the queue anymore and
+        # _require_running rejects new ops from here on
+        self._reject_pending_ops("stopped")
         # safety net: terminal events for anything the thread left open
         for st in list(self._streams.values()):
             self._emit(st, [{"event": "done", "reason": "shutdown",
                              "request_id": st.request_id}])
+        # graftlint: allow[unguarded-shared-write] -- step thread joined above; this is the post-mortem cleanup, single-threaded by construction
         self._streams.clear()
         if self._thread_error is not None:
             raise self._thread_error
@@ -176,6 +183,7 @@ class AsyncEngineBridge:
         except BaseException as e:  # surfaced by stop()
             self._thread_error = e
             self._fail_open_streams(repr(e))
+            self._reject_pending_ops("step thread crashed")
 
     def _has_work(self) -> bool:
         srv = self.srv
@@ -213,6 +221,7 @@ class AsyncEngineBridge:
                 if not self._draining or not self._has_work() \
                         or srv._now() >= drain_deadline:
                     self._fail_open_streams("shutdown", kind="done")
+                    self._reject_pending_ops("stopping")
                     return
             # 3) one engine step when there is work
             if self._has_work():
@@ -290,6 +299,22 @@ class AsyncEngineBridge:
             self._emit(st, [{"event": kind, "reason": reason,
                              "request_id": rid}])
         self._streams.clear()
+
+    def _reject_pending_ops(self, why: str) -> None:
+        """Reject the futures of ops still queued once the step thread
+        can no longer service them (post-drain stop, thread crash). A
+        lost op must fail fast — before this existed, a ``call()`` or
+        ``submit()`` racing ``stop()`` could enqueue after the thread's
+        final queue drain and await its future forever. Runs on either
+        side of the boundary (the queue is thread-safe and ``_reject``
+        marshals through the loop)."""
+        while True:
+            try:
+                kind, _payload, _stream, fut = self._ops.get_nowait()
+            except _queue.Empty:
+                return
+            self._reject(fut, RuntimeError(
+                f"bridge {why}: {kind} op was not serviced"))
 
     # -- cross-thread plumbing -----------------------------------------
     def _resolve(self, fut: Optional[asyncio.Future], value) -> None:

@@ -11,7 +11,8 @@ import sys
 
 import deepspeed_tpu
 from deepspeed_tpu.analysis import (ALL_RULES, CHECK_RULE_IDS,
-                                    SHARDING_RULES, analyze_paths,
+                                    SHARDING_RULES, SYNC_RULE_IDS,
+                                    SYNC_RULES, analyze_paths,
                                     check_paths, iter_python_files)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(
@@ -39,18 +40,20 @@ def test_gate_covers_serving_frontend():
     serving/ gate path by recursion, but pin it explicitly: the step
     thread is the one seam where host code touches the engine every
     step, so hot-loop-host-sync must keep seeing these files — and
-    they must hold at zero findings with zero pragmas (pure host-side
-    code has nothing to suppress)."""
+    they must hold at zero findings, with pragmas allowed ONLY for the
+    graftsync tier (the bridge's documented deliberate crossings; the
+    lint tier still has nothing to suppress in pure host code)."""
     rep = analyze_paths([FRONTEND])
     assert rep.files >= 4, (
         f"frontend scan saw only {rep.files} files — gate lost "
         "serving/frontend/")
     assert rep.errors == 0 and rep.warnings == 0, [
         f.format_human() for f in rep.findings]
-    assert rep.suppressed == 0, (
-        "frontend should need no pragmas — it must stay pure host "
-        "code:\n" + "\n".join(f.format_human() for f in rep.findings
-                              if f.suppressed))
+    non_sync = [f.format_human() for f in rep.findings
+                if f.suppressed and f.rule not in SYNC_RULE_IDS]
+    assert not non_sync, (
+        "frontend should need no lint-tier pragmas — it must stay pure "
+        "host code:\n" + "\n".join(non_sync))
     # and the recursive serving/ gate really does include these files
     gate_files = {f for f in iter_python_files(GATE_PATHS)}
     frontend_files = set(iter_python_files([FRONTEND]))
@@ -72,11 +75,38 @@ def test_gate_runs_every_rule():
     assert {r.id for r in ALL_RULES} == {
         "recompile-hazard", "uncommitted-buffer", "donation-after-use",
         "unsafe-scatter", "hot-loop-host-sync"}
+    assert {r.id for r in SYNC_RULES} == {
+        "blocking-call-in-coroutine", "cross-thread-engine-access",
+        "unsafe-future-resolution", "await-while-holding-lock",
+        "unguarded-shared-write"}
     assert {r.id for r in SHARDING_RULES} == {
         "mesh-axis-unknown", "shard-indivisible",
         "donation-alias-mismatch", "placement-mix"}
     assert CHECK_RULE_IDS == {r.id for r in SHARDING_RULES} | {
         "signature-escape", "unbounded-signature"}
+
+
+def test_sync_gate_zero_unsuppressed_errors():
+    """The graftsync tier alone over its gated surface (the concurrent
+    seam: frontend + engine + telemetry) holds at zero unsuppressed
+    errors, with every deliberate crossing pragma'd with a reason."""
+    surface = [os.path.join(REPO, "deepspeed_tpu", "serving", "frontend"),
+               os.path.join(REPO, "deepspeed_tpu", "serving", "engine.py"),
+               os.path.join(REPO, "deepspeed_tpu", "telemetry")]
+    rep = analyze_paths(surface, rules=SYNC_RULES)
+    offenders = [f.format_human() for f in rep.findings
+                 if f.counts_as_error]
+    assert rep.errors == 0, (
+        "graftsync gate broken — fix the finding or add a reasoned "
+        "pragma:\n" + "\n".join(offenders))
+    assert rep.warnings == 0, [f.format_human() for f in rep.findings
+                               if f.severity == "warning"]
+    assert rep.suppressed > 0, (
+        "expected the bridge's documented crossings to be pragma'd")
+    for f in rep.findings:
+        if f.suppressed:
+            assert f.rule in SYNC_RULE_IDS, f.format_human()
+            assert f.suppress_reason, f.format_human()
 
 
 def test_check_tier_gate_zero_unsuppressed_errors():

@@ -736,3 +736,68 @@ class TestBridge:
         srv = self._srv(stack)
         with pytest.raises(ValueError, match="stream_buffer"):
             AsyncEngineBridge(srv, stream_buffer=1)
+
+    def test_concurrent_calls_racing_stop_never_hang(self, stack):
+        """Stress the shutdown race: call() coroutines hammer the op
+        queue while stop(drain=True) runs. Before the bridge rejected
+        leftover ops, a call enqueued between the step thread's final
+        queue drain and its exit awaited its future forever; now every
+        racing call must either return a value or raise RuntimeError —
+        a hang fails the gather timeout below."""
+        srv = self._srv(stack)
+
+        async def one_round(bridge):
+            await bridge.start()
+            outcomes = {"ok": 0, "rejected": 0}
+
+            async def hammer():
+                while True:
+                    try:
+                        n = await bridge.call(lambda s: s.live_count)
+                    except RuntimeError:
+                        outcomes["rejected"] += 1
+                        return
+                    assert n == 0
+                    outcomes["ok"] += 1
+
+            tasks = [asyncio.ensure_future(hammer()) for _ in range(6)]
+            await asyncio.sleep(0.01)         # let the hammering overlap
+            await bridge.stop(drain=True)
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout=30)
+            assert outcomes["rejected"] == 6  # every task exited cleanly
+            assert bridge._ops.empty()        # nothing left un-serviced
+            with pytest.raises(RuntimeError, match="not running"):
+                await bridge.call(lambda s: 0)
+            return outcomes["ok"]
+
+        async def run():
+            bridge = AsyncEngineBridge(srv, idle_poll_s=0.002)
+            total_ok = 0
+            for _ in range(10):               # re-roll the race window
+                total_ok += await one_round(bridge)
+            return total_ok
+
+        total_ok = asyncio.run(run())
+        assert total_ok > 0                   # the calls really ran
+        _assert_clean(srv)
+
+    def test_ops_left_after_thread_exit_are_rejected(self, stack):
+        """Deterministic pin for the leftover-op path: an op sitting in
+        the queue once the step thread is gone must have its future
+        rejected fast (never resolved, never hung)."""
+        srv = self._srv(stack)
+
+        async def run():
+            bridge = AsyncEngineBridge(srv, idle_poll_s=0.002)
+            await bridge.start()
+            await bridge.stop(drain=False)
+            # simulate the racing op that slipped past the final drain
+            fut = asyncio.get_running_loop().create_future()
+            bridge._ops.put(("call", (lambda s: 0), None, fut))
+            bridge._reject_pending_ops("stopped")
+            with pytest.raises(RuntimeError, match="not serviced"):
+                await fut
+            assert bridge._ops.empty()
+
+        asyncio.run(run())
+        _assert_clean(srv)

@@ -163,6 +163,30 @@ class TestCheckRegression:
         assert r.returncode == 2
         assert "summary.errors" in r.stderr
 
+    def test_lint_json_repeatable_both_clean_passes(self, tmp_path):
+        # one run gates the lint-tier and `--tier sync` reports together
+        base = _write(tmp_path, "base.json", {"value": 100.0})
+        cand = _write(tmp_path, "cand.json", {"value": 100.0})
+        lint = _write(tmp_path, "lint.json", self._lint(errors=0))
+        sync = _write(tmp_path, "sync.json", self._lint(errors=0))
+        r = _run(base, cand, "--lint-json", lint, "--lint-json", sync,
+                 "--max-lint-errors", "0")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("graftlint") == 2
+        assert "lint.json" in r.stdout and "sync.json" in r.stdout
+
+    def test_lint_json_repeatable_any_dirty_fails(self, tmp_path):
+        # the cap applies to each report independently
+        base = _write(tmp_path, "base.json", {"value": 100.0})
+        cand = _write(tmp_path, "cand.json", {"value": 200.0})
+        lint = _write(tmp_path, "lint.json", self._lint(errors=0))
+        sync = _write(tmp_path, "sync.json", self._lint(errors=1))
+        r = _run(base, cand, "--lint-json", lint, "--lint-json", sync,
+                 "--max-lint-errors", "0")
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout
+        assert "sync.json" in r.stdout
+
     @staticmethod
     def _chaos(value=1.0, leaks=0, inv=True, tl=True):
         return {"value": value,
