@@ -96,6 +96,19 @@ error (exit 2)::
     python check_regression.py BASE.json BENCH.json \
         --signatures-json signatures.json --require-signature-match
 
+The ``serving-decode`` row composes the full stack — a hard gate on
+the kernel arm's p99 inter-token gap, a warn-only MFU floor, and both
+zero-recompile gates (runtime watchdog + static signature match)::
+
+    python bench.py serving-decode --json BENCH_serving_decode.json \
+        --signatures signatures.json
+    python check_regression.py BENCH_serving_decode.base.json \
+        BENCH_serving_decode.json \
+        --metric value:lower \
+        --warn-metric detail.efficiency.mfu:higher \
+        --max-recompiles 0 \
+        --signatures-json signatures.json --require-signature-match
+
 ``--warn-metric PATH[:higher|lower]`` runs the same relative
 comparison as ``--metric`` but never fails the gate — it prints
 ``WARNING`` instead of ``REGRESSION``. Use it for metrics that are

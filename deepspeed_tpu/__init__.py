@@ -159,10 +159,17 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
     copy-on-write sharing (vLLM PagedAttention + SGLang RadixAttention;
     greedy output stays bitwise identical). ``True`` for defaults (page
     size = the prefill chunk, ``num_pages`` = worst-case), or a dict
-    ``{"num_pages": int, "page_size": int, "prefix_cache": bool}`` —
-    ``num_pages`` below ``num_slots * max_seq_len / page_size``
-    oversubscribes HBM; pressure is drained by trie eviction, then
-    automatic preemption.
+    ``{"num_pages": int, "page_size": int, "prefix_cache": bool,
+    "kernel": "auto"|"on"|"off"}`` — ``num_pages`` below
+    ``num_slots * max_seq_len / page_size`` oversubscribes HBM;
+    pressure is drained by trie eviction, then automatic preemption.
+    ``kernel`` selects the fused Pallas paged-attention decode/verify
+    path (``"auto"`` arms it on real TPU hardware only; the dense
+    gather path stays the bitwise-parity oracle). ``overlap`` pipelines
+    ``step()`` — decode dispatches first, host bookkeeping overlaps the
+    in-flight device work, and token fetches collapse onto one
+    end-of-step sync — with outcomes bitwise identical to the serial
+    step.
 
     The efficiency/goodput observability keys (all server-global):
     ``cost_model`` (``True``, a :class:`telemetry.ProgramCostModel`
@@ -199,8 +206,8 @@ def init_serving(model: Any = None, config: Union[str, Dict, None] = None,
                   "deadline_default_ms", "step_wall_budget_ms",
                   "guard_numerics", "degradation",
                   "preempt_queue_threshold", "preempt_min_run_steps",
-                  "fault_injector", "paged_kv", "cost_model", "slo",
-                  "flight_recorder", "dump_dir", "priority", "clock")
+                  "fault_injector", "paged_kv", "overlap", "cost_model",
+                  "slo", "flight_recorder", "dump_dir", "priority", "clock")
     serve_kwargs = {k: kwargs.pop(k) for k in serve_keys if k in kwargs}
     engine = init_inference(model=model, config=config, **kwargs)
     return ServingEngine(engine, num_slots=num_slots,

@@ -273,6 +273,23 @@ WATCHED_MODELS = {
          Arr((_batch_of(args[2]),), "int32", COMMITTED)]),
     "_jit_finite": lambda args, kw, env: Arr(
         (_batch_of(args[0]),), "bool", COMMITTED),
+    # fused paged-attention kernel arms: same caller-visible contract as
+    # the dense compositions they replace
+    "_paged_decode_kernel_jit": lambda args, kw, env: Tup(
+        [_logits(_batch_of(args[2]), env), Tree(COMMITTED, "pool")]),
+    "_paged_verify_kernel_jit": lambda args, kw, env: Tup(
+        [Tree(COMMITTED, "pool"),
+         Arr((_batch_of(args[2]),
+              args[2].shape[1] if isinstance(args[2], Arr)
+              and args[2].ndim > 1 else Known(1)), "int32", COMMITTED),
+         Arr((_batch_of(args[2]),), "int32", COMMITTED)]),
+    # device current-token twin plumbing: scatter returns the (S,) twin
+    # it was handed; spec-cur collapses a (S, K+1) verify output to (S,)
+    "_jit_cur_scatter": lambda args, kw, env: args[0]
+    if isinstance(args[0], Arr)
+    else Arr((Known(int(env["num_slots"])),), "int32", COMMITTED),
+    "_jit_spec_cur": lambda args, kw, env: Arr(
+        (_batch_of(args[0]),), "int32", COMMITTED),
     "_argmax": lambda args, kw, env: Arr((), "int32", COMMITTED),
 }
 
@@ -1267,6 +1284,14 @@ def _pool_obj(env: dict, engine: Obj) -> Obj:
             "_paged_decode_jit": Obj("jit"),
             "_paged_verify_jit": Obj("jit"),
             "_paged_chunk_jit": Obj("jit"),
+            # the fused-kernel arms exist iff the env arms them
+            # (``paged_kernel_active`` in ``_signature_env``); the
+            # precise is-not-None nullness test then picks the dispatch
+            # branch instead of forking both
+            "_paged_decode_kernel_jit": Obj("jit")
+            if env.get("paged_kernel_active") else Scalar(None),
+            "_paged_verify_kernel_jit": Obj("jit")
+            if env.get("paged_kernel_active") else Scalar(None),
         })
         return Obj("PagedKVPool", attrs)
     return Obj("SlotPool", attrs)
@@ -1299,6 +1324,10 @@ def _serving_obj(env: dict) -> Obj:
         "_greedy": Arr((), "bool", UNCOMMITTED),
         "_rng": Arr((2,), "uint32", HOST),
         "_current": Arr((S,), "int32", HOST),
+        "_cur_dev": Arr((S,), "int32", COMMITTED),
+        "_overlap": Scalar(bool(env.get("overlap"))),
+        "_deferred": ListOf(Unknown("deferred fetch"), maybe_empty=True),
+        "timers": Obj("opaque"),
         "_slot_req": Obj("opaque"),
         "tracer": Obj("opaque"),
         "metrics": Obj("opaque"),
@@ -1461,6 +1490,10 @@ def default_check_envs() -> List[dict]:
         dict(paging, paged=False, page_size=0, num_pages=0,
              pages_per_slot=0, num_slots=4, use_prefix=False,
              stall_free=True),
+        # the serving-decode bench row's fused-kernel arm: same paged
+        # config, decode/verify dispatch through the Pallas kernel jits
+        dict(paging, stall_free=True, paged_kernel="on",
+             paged_kernel_active=True),
     ]
 
 

@@ -512,6 +512,16 @@ class HotLoopHostSyncRule(Rule):
                 f.attr in ("item", "tolist", "block_until_ready") and \
                 self._expr_device(f.value, tainted):
             return f"`.{f.attr}()` blocks on a device value"
+        if isinstance(f, ast.Attribute) and f.attr == "stop":
+            # Timer.stop(block_on=...) exists to block_until_ready the
+            # values it is handed — it IS a host sync, whatever the
+            # taint tracker knows about the bundle's provenance
+            for kw in call.keywords:
+                if kw.arg == "block_on" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None):
+                    return ("`stop(block_on=...)` blocks until the "
+                            "device values it is handed exist")
         return None
 
     def _scan_method(self, ctx, fi) -> Iterator[Finding]:

@@ -199,8 +199,11 @@ def _asarray(placement_default: str):
         if isinstance(x, Tup):
             dims = [as_dim(i) for i in x.items]
             if all(d is not None for d in dims):
+                # jnp default-int is int32 (x64 disabled); np is int64
+                base = "int32" if placement_default == UNCOMMITTED \
+                    else "int64"
                 return Arr((Known(len(dims)),),
-                           dtype_name(dt, "int64"), HOST)
+                           dtype_name(dt, base), HOST)
         if isinstance(x, Tree):
             return x
         return Unknown("asarray of unknown operand")

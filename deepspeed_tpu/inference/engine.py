@@ -650,23 +650,31 @@ class InferenceEngine:
             toks = np.concatenate([np.asarray(token)[:, None],
                                    np.asarray(rest)[:, :n_steps]], axis=1)
         else:
-            # eager loop: checks eos on host each step for early exit
+            # eager loop with pipelined eos check: step j+1 is DISPATCHED
+            # before step j's tokens are pulled to the host, so the eos
+            # fetch overlaps the in-flight decode instead of serializing
+            # every iteration on a device round-trip. When the check says
+            # everyone finished, the just-dispatched step's token is
+            # dropped — output width and values match the serial loop
+            # bitwise (the speculative step consumed one rng split, but
+            # nothing after the break reads the stream).
             dev_out = [token]
-            finished = np.asarray(token) == eos_token_id
-
+            finished = np.zeros((np.shape(input_ids)[0],), bool)
             pos = T
             for _ in range(max_new_tokens - 1):
-                if finished.all():
-                    break
                 logits, cache = self._jit_decode(
                     self.params, cache, token[:, None],
                     jnp.asarray(pos, jnp.int32))
                 rng, sub = jax.random.split(rng)
-                token = self._jit_sample(
+                nxt = self._jit_sample(
                     logits, sub, jnp.asarray(temperature, jnp.float32),
                     int(top_k), float(top_p), greedy)
-                dev_out.append(token)
+                # host sync on the PREVIOUS token while this step runs
                 finished |= np.asarray(token) == eos_token_id
+                if finished.all():
+                    break
+                token = nxt
+                dev_out.append(token)
                 pos += 1
             toks = np.stack([np.asarray(t) for t in dev_out], axis=1)
         if eos_token_id is not None:

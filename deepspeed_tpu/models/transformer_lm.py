@@ -71,6 +71,12 @@ class TransformerConfig:
     # alibi and train-mode attention dropout stay on the einsum path)
     remat: bool = False
     decode_kernel: str = "auto"         # auto | on | off (fused Pallas decode)
+    decode_block: Optional[int] = None  # pin the fused decode kernel's block
+    # granule (STATIC int). The paged-attention kernel's position block is
+    # one page, so a dense arm pinned to decode_block=page_size runs the
+    # SAME online-softmax blocking — the bitwise-parity oracle for the
+    # paged kernel (ops/attention/paged_attention.py). None keeps the
+    # allocation-based default (pick_block_s).
     kv_cache_quant: bool = False        # int8 KV cache (per-row scales):
     # halves the cache's HBM traffic — the resource decode is bound by —
     # and halves KV memory, doubling the servable context per chip
@@ -267,6 +273,85 @@ class CachedAttention(nn.Module):
             return True
         return jax.default_backend() == "tpu"
 
+    def _paged_decode_step(self, q, k, v, kv_cache, positions,
+                           deterministic):
+        """Decode/verify step over PAGED storage: write this step's K/V
+        columns straight into the page pool through the table (sentinel
+        entries drop — the ``_scatter_cols`` discipline, applied at the
+        source) and attend via the fused paged kernel. The value bytes
+        written and the attention math match the dense path exactly
+        (same quantize/pack pipeline, kernel compute copied op-for-op
+        from the dense decode kernel), which is what keeps paged-kernel
+        greedy output bitwise-identical to the dense oracle."""
+        cfg = self.config
+        B, T, H, D = q.shape
+        kv_packed = kv_cache_spec(cfg)[2]
+        from ..ops.attention.paged_attention import (
+            MAX_QUERY_ROWS,
+            paged_decode_attention,
+        )
+
+        assert T <= MAX_QUERY_ROWS, \
+            (f"paged-kernel decode handles T <= {MAX_QUERY_ROWS} query "
+             f"rows (plain decode and speculative verify); T={T} callers "
+             f"take the dense-composition path")
+        start = kv_cache["start"]
+        assert jnp.ndim(start) == 1, \
+            "paged decode is slot-pooled: start must be (B,)"
+        table = kv_cache["table"]                  # (B, pages_per_slot)
+        P = kv_cache["k"].shape[0]
+        ps = kv_cache["k"].shape[-1]
+        maxP = table.shape[1]
+        new_cache = {key: val for key, val in kv_cache.items()
+                     if key not in ("start", "table")}
+
+        # column writes through the table (mode="drop" for sentinels)
+        pos_w = positions.astype(jnp.int32)               # (B, T) absolute
+        pidx = pos_w // ps
+        valid = (pos_w >= 0) & (pos_w < maxP * ps)
+        pages = jnp.take_along_axis(table, jnp.clip(pidx, 0, maxP - 1),
+                                    axis=1)
+        pages = jnp.where(valid, pages, P)
+        offs = pos_w % ps
+        k_rows = k.astype(cfg.dtype).transpose(0, 2, 1, 3)  # (B, KV, T, D)
+        v_rows = v.astype(cfg.dtype).transpose(0, 2, 1, 3)
+        scales = {}
+        if cfg.kv_cache_quant:
+            from ..ops.attention.decode_attention import (
+                pack_int8_sublanes,
+                quantize_kv_rows,
+            )
+
+            k_rows, k_sc = quantize_kv_rows(k_rows)       # scales (B,KV,T)
+            v_rows, v_sc = quantize_kv_rows(v_rows)
+            for key, sc in (("k_scale", k_sc), ("v_scale", v_sc)):
+                buf = kv_cache[key]                       # (P, KV, ps)
+                new_cache[key] = buf.at[pages, :, offs].set(
+                    sc.transpose(0, 2, 1).astype(buf.dtype), mode="drop")
+            scales = dict(k_scale_pages=new_cache["k_scale"],
+                          v_scale_pages=new_cache["v_scale"])
+        k_cols = k_rows.transpose(0, 1, 3, 2)             # (B, KV, D, T)
+        v_cols = v_rows.transpose(0, 1, 3, 2)
+        if kv_packed:
+            from ..ops.attention.decode_attention import pack_int8_sublanes
+
+            k_cols = pack_int8_sublanes(k_cols)           # (B, KV, D//4, T)
+            v_cols = pack_int8_sublanes(v_cols)
+        for key, cols in (("k", k_cols), ("v", v_cols)):
+            buf = kv_cache[key]                           # (P, KV, cd, ps)
+            vals = cols.transpose(0, 3, 1, 2)             # (B, T, KV, cd)
+            new_cache[key] = buf.at[pages, :, :, offs].set(
+                vals.astype(buf.dtype), mode="drop")
+
+        slopes = alibi_slopes(H) if cfg.pos_emb == "alibi" else None
+        y = paged_decode_attention(
+            q.astype(cfg.dtype), new_cache["k"], new_cache["v"], table,
+            start, alibi_slopes=slopes, **scales)
+        y = y.astype(cfg.dtype).reshape(B, T, H * D)
+        o_proj = _dense(cfg, self.config.n_embd, use_bias=cfg.qkv_bias,
+                        name="o_proj")
+        return o_proj(y), new_cache
+
     @nn.compact
     def __call__(self, x, *, decode: Union[bool, str] = False,
                  deterministic: bool = True, kv_cache=None,
@@ -307,6 +392,16 @@ class CachedAttention(nn.Module):
             rd = int(cfg.rotary_pct * D) // 2 * 2
             q = apply_rotary(q, positions, rotary_dim=rd, theta=cfg.rope_theta)
             k = apply_rotary(k, positions, rotary_dim=rd, theta=cfg.rope_theta)
+
+        if decode and kv_cache is not None and "table" in kv_cache:
+            # Paged decode: this layer's K/V live in the PAGE POOL
+            # ((P, KV, cache_d, page_size), no batch axis) and both the
+            # column writes and the attention read resolve positions
+            # through the per-slot page table — no dense per-slot view is
+            # ever materialized (the gather→attend→scatter round-trip the
+            # fused kernel eliminates; ops/attention/paged_attention.py).
+            return self._paged_decode_step(q, k, v, kv_cache, positions,
+                                           deterministic)
 
         kv_scales = None  # set on the quantized-cache einsum fallback
         # "fresh" attention = causal over the just-computed k/v. True for
@@ -375,8 +470,10 @@ class CachedAttention(nn.Module):
                 y = decode_attention(
                     q[:, 0].astype(cfg.dtype), new_cache["k"],
                     new_cache["v"], start + 1, alibi_slopes=slopes,
-                    block_s=pick_block_s(cfg.max_seq_len,
-                                         preferred=block_hint), **scales)
+                    block_s=pick_block_s(
+                        cfg.max_seq_len,
+                        preferred=(block_hint if block_hint is not None
+                                   else cfg.decode_block)), **scales)
                 y = y.astype(cfg.dtype).reshape(B, 1, H * D)
                 return o_proj(y), new_cache
             if not fresh:
@@ -544,14 +641,20 @@ class _ScanBlock(nn.Module):
         if cache is None:
             x, _ = block(x, decode, deterministic, None, block_hint)
             return (x, None, start, li), None
+        # "table" is the POOL-WIDE page table (slots, pages_per_slot) —
+        # shared by every layer, so it rides the slice whole and is never
+        # written back (the paged branch returns k/v pages only)
         kv_slice = {key: jax.lax.dynamic_index_in_dim(val, li, 0,
                                                       keepdims=False)
-                    for key, val in cache.items()}
+                    for key, val in cache.items() if key != "table"}
         kv_slice["start"] = start
+        if "table" in cache:
+            kv_slice["table"] = cache["table"]
         x, new_slice = block(x, decode, deterministic, kv_slice, block_hint)
-        cache = {key: jax.lax.dynamic_update_slice_in_dim(
-                     cache[key], new_slice[key][None], li, 0)
-                 for key in cache}
+        cache = {key: (val if key == "table"
+                       else jax.lax.dynamic_update_slice_in_dim(
+                           val, new_slice[key][None], li, 0))
+                 for key, val in cache.items()}
         return (x, cache, start, li + 1), None
 
 
@@ -787,7 +890,7 @@ class TransformerLM(nn.Module):
                                   dtype=jnp.float32, name="lm_head")
 
     def _transform(self, input_ids, positions, decode, deterministic,
-                   block_hint=None, head=True):
+                   block_hint=None, head=True, paged_table=None):
         cfg = self.config
         B, T = input_ids.shape
         x = self.embed_tokens(input_ids)
@@ -797,9 +900,19 @@ class TransformerLM(nn.Module):
             x = self.embed_ln(x)
         if decode:
             cache, start = self.cache_store(B)
+            if paged_table is not None:
+                # paged-kernel decode: the cache_store variables hold the
+                # PAGE POOL (L, P, KV, cd, page_size) — provided-cache
+                # shapes pass through — and the shared page table joins
+                # the carry so every layer resolves positions through it
+                # (stripped before writeback; see _ScanBlock)
+                cache = dict(cache, table=paged_table)
             carry = (x, cache, start, jnp.zeros((), jnp.int32))
             (x, cache, _, _), _ = self.blocks(carry, decode, deterministic,
                                               block_hint)
+            if paged_table is not None:
+                cache = {key: val for key, val in cache.items()
+                         if key != "table"}
             self.cache_store(B, new_values=cache, new_index=start + T)
         else:
             carry = (x, None, jnp.zeros((), jnp.int32),
@@ -900,6 +1013,25 @@ class TransformerLM(nn.Module):
         off = start_pos[:, None] if jnp.ndim(start_pos) == 1 else start_pos
         pos = off + jnp.broadcast_to(jnp.arange(T)[None], (B, T))
         return self._transform(input_ids, pos, True, True, block_hint)
+
+    def decode_paged(self, input_ids, start_pos, table):
+        """Fused paged-kernel decode step: like :meth:`decode`, but the
+        provided ``cache`` collection holds the PAGE POOL arrays
+        (``KVCacheSpec.paged_cache`` layout — k/v (L, P, KV, cache_d,
+        page_size), no batch axis) and ``table`` is the (B,
+        pages_per_slot) int32 page table (sentinel = num_pages). Column
+        writes scatter through the table and attention reads pages in
+        place inside the fused kernel — no dense per-slot view is ever
+        materialized. ``start_pos`` must be the per-slot (B,) cache
+        lengths; handles 1 <= T <= MAX_QUERY_ROWS query rows (plain
+        decode and speculative verify). Call with ``mutable=["cache"]``;
+        greedy output is bitwise-identical to the dense-oracle
+        :meth:`decode` over ``dense_from_pages`` of the same pool."""
+        B, T = input_ids.shape
+        off = start_pos[:, None] if jnp.ndim(start_pos) == 1 else start_pos
+        pos = off + jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        return self._transform(input_ids, pos, True, True,
+                               paged_table=table)
 
     def __call__(self, batch, deterministic: bool = False):
         cfg = self.config

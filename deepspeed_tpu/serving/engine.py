@@ -83,11 +83,13 @@ from typing import Any, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..telemetry import (FlightRecorder, MetricsRegistry, ProgramCostModel,
                          RecompileAfterWarmupError, RecompileWatchdog,
                          SLOTracker, TimelineStore, Tracer)
 from ..utils.logging import log_dist
+from ..utils.timer import SynchronizedWallClockTimer
 from .metrics import ServingMetrics
 from .paged_pool import PagedKVPool, PagePoolExhausted
 from .request import FinishReason, RejectReason, Request, RequestState
@@ -105,8 +107,10 @@ _WATCHED_ENGINE_JITS = ("_jit_prefill_at", "_jit_decode",
                         "_jit_verify_k", "_jit_decode_scan")
 _WATCHED_POOL_JITS = ("_admit_jit", "_admit_rows_jit",
                       "_paged_decode_jit", "_paged_verify_jit",
+                      "_paged_decode_kernel_jit",
+                      "_paged_verify_kernel_jit",
                       "_paged_chunk_jit", "_jit_copy_page")
-_WATCHED_SERVING_JITS = ("_jit_finite",)
+_WATCHED_SERVING_JITS = ("_jit_finite", "_jit_cur_scatter", "_jit_spec_cur")
 # the model drafter jits its own last-token argmax (lazily, on the
 # first propose); unwatched it was the one serving-side jit that could
 # recompile post-warmup without attribution — found by the graftlint
@@ -152,7 +156,8 @@ class ServingEngine:
                  flight_recorder: Any = True,
                  dump_dir: Optional[str] = None,
                  priority: Any = None,
-                 clock: Optional[Any] = None):
+                 clock: Optional[Any] = None,
+                 overlap: bool = False):
         self.engine = engine
         # ONE monotonic clock for every time-dependent decision —
         # deadline stamps, queue expiry, SLO latencies, degradation
@@ -199,13 +204,18 @@ class ServingEngine:
                     page_size //= 2
             num_pages = knobs.pop("num_pages", None)
             use_prefix = bool(knobs.pop("prefix_cache", True))
+            # paged_kernel: "off" (dense gather/scatter composition — the
+            # bitwise oracle), "on" (fused in-place paged-attention
+            # kernel, interpret mode off-TPU), "auto" (kernel on TPU)
+            paged_kernel = str(knobs.pop("kernel", "auto"))
             if knobs:
                 raise ValueError(f"unknown paged_kv keys: {sorted(knobs)}; "
                                  f"expected num_pages/page_size/"
-                                 f"prefix_cache")
+                                 f"prefix_cache/kernel")
             self.pool = PagedKVPool(spec, num_slots, num_pages=num_pages,
                                     page_size=int(page_size), sharding=rep,
-                                    prefix_cache=use_prefix)
+                                    prefix_cache=use_prefix,
+                                    kernel=paged_kernel)
         else:
             self.pool = SlotPool(spec, num_slots, sharding=rep)
         self._paged = isinstance(self.pool, PagedKVPool)
@@ -383,6 +393,53 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(seed)
         self._slot_req: dict = {}                      # slot -> Request
         self._current = np.zeros((num_slots,), np.int32)  # last token per slot
+        # device twin of _current: decode/spec dispatch read it so a step
+        # never blocks on the previous step's sampled token reaching the
+        # host. The host copy is refreshed at the single end-of-step fetch.
+        # device_put with the mesh's replicated sharding (not jnp.zeros)
+        # so the array is COMMITTED and placed exactly like the jit
+        # outputs that later replace it — an uncommitted or
+        # single-device first arg would give _jit_cur_scatter a second
+        # cache entry for the same shapes, a recompile the watchdog
+        # rightly flags.
+        self._cur_dev = jax.device_put(
+            np.zeros((num_slots,), np.int32), self._rep_sharding())
+        self._jit_cur_scatter = jax.jit(
+            lambda cur, tok, slots: cur.at[slots].set(tok, mode="drop"))
+        # after a verify step the new current token for row b is the last
+        # *emitted* token: out[b, n_emit[b]-1] (n_emit >= 1 for live rows;
+        # the max() guards masked rows, whose value is never surfaced)
+        self._jit_spec_cur = jax.jit(
+            lambda out, n_emit: jnp.take_along_axis(
+                out, jnp.maximum(n_emit - 1, 0)[:, None],
+                axis=1)[:, 0].astype(jnp.int32))
+        self._overlap = bool(overlap)
+        # pre-warm every reachable cur-scatter width NOW, before the
+        # watchdog attaches below: singles scatter (1,) and batched
+        # admissions scatter the power-of-two group buckets, a bounded
+        # family warmup traffic cannot be relied on to sweep (an engine
+        # warmed on sequential requests would otherwise compile its
+        # first batched bucket under load)
+        rep = self._rep_sharding()
+        nb = 1
+        while True:
+            self._jit_cur_scatter(
+                self._cur_dev,
+                jax.device_put(np.zeros((nb,), np.int32), rep),
+                jnp.asarray(np.full((nb,), num_slots, np.int32)))
+            if nb >= num_slots:
+                break
+            nb *= 2
+        if self._spec is not None:
+            self._jit_spec_cur(
+                jax.device_put(np.zeros((num_slots, self._spec.k + 1),
+                                        np.int32), rep),
+                jax.device_put(np.ones((num_slots,), np.int32), rep))
+        # deferred host work: (device_arrays, callback) pairs queued at
+        # dispatch time and replayed — in dispatch order — after the one
+        # blocking fetch in _drain_deferred at the end of step()
+        self._deferred: List[Any] = []
+        self.timers = SynchronizedWallClockTimer()
         self._next_id = 0
         self._ensure_watch()
         log_dist(f"ServingEngine: slots={num_slots} policy={policy} "
@@ -430,6 +487,10 @@ class ServingEngine:
             "prefill_chunk": int(self.prefill_chunk or 0),
             "prefill_token_budget": int(self.prefill_token_budget or 0),
             "paged": bool(self._paged),
+            "paged_kernel": str(getattr(pool, "kernel", "off"))
+            if self._paged else "off",
+            "paged_kernel_active": bool(getattr(pool, "kernel_active",
+                                                False)),
             "page_size": int(getattr(pool, "page_size", 0) or 0),
             "num_pages": int(getattr(pool, "num_pages", 0) or 0),
             "pages_per_slot": int(getattr(pool, "pages_per_slot", 0) or 0),
@@ -441,6 +502,7 @@ class ServingEngine:
             "guard_numerics": self._jit_finite is not None,
             "use_prefix": bool(self._use_prefix),
             "stall_free": bool(self._stall_free),
+            "overlap": bool(self._overlap),
         }
 
     def export_signatures(self, path: str, merge: bool = False,
@@ -788,12 +850,51 @@ class ServingEngine:
             and self.scheduler.rank_of(req.priority_class) > floor
 
     # ------------------------------------------------------------------
-    def _sample(self, logits) -> np.ndarray:
+    @staticmethod
+    def _rep_sharding():
+        """Replicated NamedSharding on the global mesh — the placement
+        every serving jit output carries, so host-built device arrays
+        (the current-token twin) share a jit cache entry with them."""
+        from ..parallel import mesh as mesh_mod
+        return NamedSharding(mesh_mod.get_mesh(), PartitionSpec())
+
+    def _sample_dev(self, logits):
+        """Dispatch the sampler and return the token *device* array.
+
+        No host sync happens here: callers stash the array (plus a
+        closure that needs its host value) via :meth:`_defer`, and the
+        single blocking fetch at the end of :meth:`step` replays every
+        closure in dispatch order. Per-row sampling is independent
+        (``categorical``/``argmax`` act row-wise on one split key), so
+        batching rows from different call sites cannot change values."""
         self._rng, sub = jax.random.split(self._rng)
-        # graftlint: allow[hot-loop-host-sync] -- the sampler IS the step's one deliberate sync: tokens must reach the host to extend requests
-        return np.asarray(self.engine._jit_sample(
+        return self.engine._jit_sample(
             logits, sub, jnp.asarray(self.temperature, jnp.float32),
-            int(self.top_k), float(self.top_p), self._greedy))
+            int(self.top_k), float(self.top_p), self._greedy)
+
+    def _defer(self, arrays, callback) -> None:
+        """Queue ``callback(*host_values)`` until the end-of-step fetch.
+
+        ``arrays`` is a list of device arrays; the callback receives the
+        same list with every element converted via ``np.asarray`` after
+        the step's one ``block_until_ready``."""
+        self._deferred.append((list(arrays), callback))
+
+    def _drain_deferred(self, *, sync: bool = True) -> None:
+        """The step's single device sync: block on every deferred array
+        at once, then replay the queued host bookkeeping in dispatch
+        order. ``serving/step_fetch`` times exactly the blocking wait."""
+        if not self._deferred:
+            return
+        pending, self._deferred = self._deferred, []
+        bundle = [a for arrays, _ in pending for a in arrays]
+        if sync:
+            timer = self.timers("serving/step_fetch")
+            timer.start()
+            # graftlint: allow[hot-loop-host-sync] -- the step's ONE deliberate sync: every deferred token/flag fetch collapses onto this block
+            timer.stop(block_on=bundle)
+        for arrays, callback in pending:
+            callback(*[np.asarray(a) for a in arrays])
 
     @staticmethod
     def _bucket(n: int, cap: int) -> int:
@@ -827,25 +928,34 @@ class ServingEngine:
                     jnp.asarray(T - 1, jnp.int32))
                 self.pool.admit(pre_cache, slot, T)
                 with self.tracer.span("serving/sample"):
-                    # device sync: token exists
-                    token = int(self._sample(logits)[0])
+                    # dispatch only; the host value arrives at the
+                    # end-of-step fetch
+                    tok_dev = self._sample_dev(logits)
+                self._cur_dev = self._jit_cur_scatter(
+                    self._cur_dev, tok_dev, jnp.asarray([slot]))
             now = self._now()
-            if req.first_token_time is None:
-                req.first_token_time = now
             self.metrics.record_prefill(T, now - req.admit_time,
                                         blocking=running_before > 0)
             req.slot = slot
             self._slot_req[slot] = req
             req.state = RequestState.RUNNING
             req.last_admit_step = self.step_id
-            req.output_tokens.append(token)
-            self._tokens_emitted += 1
-            self._current[slot] = token
             self.timelines.record(req.request_id, "admitted", slot=slot,
                                   mode="bucketed")
-            if n0 == 0:
-                self.timelines.record(req.request_id, "first_token")
             self.tracer.flow("s", "req", req.request_id)
+
+            def _on_first_token(tok, req=req, slot=slot, n0=n0):
+                token = int(tok[0])
+                if req.first_token_time is None:
+                    req.first_token_time = self._now()
+                req.output_tokens.append(token)
+                self._tokens_emitted += 1
+                self._current[slot] = token
+                if n0 == 0:
+                    self.timelines.record(req.request_id, "first_token")
+                self._maybe_retire(req, token, finished)
+
+            self._defer([tok_dev], _on_first_token)
         except Exception:
             # undo the partial admission so the request can be re-queued
             # with no trace: the slot goes back and timing/output state
@@ -863,7 +973,6 @@ class ServingEngine:
             # publish the freshly-prefilled full prompt pages (refcounted
             # past this slot's lifetime) for the next same-prefix request
             self.pool.cache_prefix(slot, seed)
-        self._maybe_retire(req, token, finished)
 
     def _running_count(self) -> int:
         return sum(1 for r in self._slot_req.values()
@@ -1076,30 +1185,41 @@ class ServingEngine:
                     eng.params, jnp.asarray(ids), jnp.asarray(last_pos))
                 self.pool.admit_rows(pre_cache, slots, lengths)
                 with self.tracer.span("serving/sample"):
-                    tokens = self._sample(logits)  # device sync
+                    # dispatch only; host values arrive at the
+                    # end-of-step fetch
+                    tokens_dev = self._sample_dev(logits)
+                self._cur_dev = self._jit_cur_scatter(
+                    self._cur_dev, tokens_dev, jnp.asarray(slots))
             now = self._now()
             self.metrics.record_prefill(int(lengths.sum()), now - t0,
                                         blocking=running_before > 0)
             for i, req in enumerate(group):
-                token = int(tokens[i])
                 slot = int(slots[i])
-                if req.first_token_time is None:
-                    req.first_token_time = now
                 req.slot = slot
                 self._slot_req[slot] = req
                 req.state = RequestState.RUNNING
                 req.last_admit_step = self.step_id
-                req.output_tokens.append(token)
-                self._tokens_emitted += 1
-                self._current[slot] = token
                 self.timelines.record(req.request_id, "admitted", slot=slot,
                                       mode="batched")
-                if n0s[i] == 0:
-                    self.timelines.record(req.request_id, "first_token")
                 self.tracer.flow("s", "req", req.request_id)
                 if self._use_prefix:
                     self.pool.cache_prefix(slot, req.seed_tokens)
-                self._maybe_retire(req, token, finished)
+
+            def _on_batch_tokens(tokens, group=group, slots=slots, n0s=n0s):
+                now = self._now()
+                for i, req in enumerate(group):
+                    token = int(tokens[i])
+                    slot = int(slots[i])
+                    if req.first_token_time is None:
+                        req.first_token_time = now
+                    req.output_tokens.append(token)
+                    self._tokens_emitted += 1
+                    self._current[slot] = token
+                    if n0s[i] == 0:
+                        self.timelines.record(req.request_id, "first_token")
+                    self._maybe_retire(req, token, finished)
+
+            self._defer([tokens_dev], _on_batch_tokens)
         except Exception:
             # roll the whole group back to clean QUEUED requests so
             # _abort_step re-queues them with no trace (resumed members
@@ -1155,24 +1275,32 @@ class ServingEngine:
                               len=L)
         if req.prefill_pos >= seed_len:
             with self.tracer.span("serving/sample"):
-                token = int(self._sample(logits)[0])  # device sync
-            now = self._now()
-            self.metrics.record_prefill(L, now - t0,
+                # dispatch only; host value arrives at the end-of-step
+                # fetch
+                tok_dev = self._sample_dev(logits)
+            self._cur_dev = self._jit_cur_scatter(
+                self._cur_dev, tok_dev, jnp.asarray([slot]))
+            self.metrics.record_prefill(L, self._now() - t0,
                                         blocking=running_before > 0)
             self._prefill_queue.pop(0)
-            first = req.first_token_time is None
-            if first:
-                req.first_token_time = now
             req.state = RequestState.RUNNING
             req.last_admit_step = self.step_id
-            req.output_tokens.append(token)
-            self._tokens_emitted += 1
-            self._current[slot] = token
-            if first:
-                self.timelines.record(req.request_id, "first_token")
             if self._use_prefix:
                 self.pool.cache_prefix(slot, seed)
-            self._maybe_retire(req, token, finished)
+
+            def _on_chunk_token(tok, req=req, slot=slot):
+                token = int(tok[0])
+                first = req.first_token_time is None
+                if first:
+                    req.first_token_time = self._now()
+                req.output_tokens.append(token)
+                self._tokens_emitted += 1
+                self._current[slot] = token
+                if first:
+                    self.timelines.record(req.request_id, "first_token")
+                self._maybe_retire(req, token, finished)
+
+            self._defer([tok_dev], _on_chunk_token)
         else:
             # no sync: the chunk is enqueued and this step's decode
             # dispatch overlaps its host-side latency — the device
@@ -1445,6 +1573,19 @@ class ServingEngine:
                         self.pool.free_count, self.live_count,
                         page_budget=page_budget, page_cost=page_cost)
             try:
+                decoded = False
+                if self._overlap and self._running_count():
+                    # pipelined order: the decode (or draft+verify) for
+                    # the slots ALREADY running is dispatched first, so
+                    # admission/prefill host bookkeeping below overlaps
+                    # the in-flight device step. Slots admitted this
+                    # step join the decode batch next step.
+                    t0 = self._now()
+                    if self._spec is not None:
+                        self._spec_decode_step(finished, t0)
+                    else:
+                        self._decode_step(finished, t0)
+                    decoded = True
                 if self._stall_free:
                     self._admit_stall_free(granted, finished)
                     self._prefill_chunk_step(finished)
@@ -1458,12 +1599,16 @@ class ServingEngine:
                     # state has moved yet
                     self.faults.maybe_sleep("slow_dispatch")
                     self.faults.check("step_host_error")
-                if self._running_count():
+                if not decoded and self._running_count():
                     t0 = self._now()
                     if self._spec is not None:
                         self._spec_decode_step(finished, t0)
                     else:
                         self._decode_step(finished, t0)
+                # the step's ONE device sync: fetch every deferred
+                # token/flag at once, then replay host bookkeeping in
+                # dispatch order
+                self._drain_deferred()
             except Exception:
                 self._abort_step(granted)
                 raise
@@ -1484,6 +1629,9 @@ class ServingEngine:
             self._chaos_corrupt_state()
         wall = self._now() - t_step
         self._telemetry_step(wall, running_at_entry, granted, finished)
+        # drain serving/step_fetch (the single-sync wait) into
+        # timer/*_ms histograms alongside the rest of the step metrics
+        self.timers.publish(self.registry)
         # strict-mode recompile gate sits at the step boundary: raising
         # mid-step would trigger _abort_step and FAIL innocent in-flight
         # requests, when the state is actually perfectly consistent
@@ -1565,18 +1713,18 @@ class ServingEngine:
                               reason=reason.value,
                               new_tokens=len(req.output_tokens))
 
-    def _guard_logits(self, logits, running):
-        """NaN/inf guard on the decode logits: returns the survivors of
-        ``running``, failing only rows whose logits are non-finite. One
-        fixed-shape watched jit + one tiny host sync, only when
-        ``guard_numerics`` is on."""
-        if self._jit_finite is None or not running:
+    def _guard_rows(self, finite, running):
+        """Replay half of the NaN/inf guard: given the fetched (B,) bool
+        of per-row finiteness, return the survivors of ``running`` and
+        fail the poisoned rows. Runs inside the deferred drain — the
+        finite vector rode the step's one fetch instead of buying its
+        own sync."""
+        if finite is None:
             return running
-        # graftlint: allow[hot-loop-host-sync] -- tiny (B,) bool pulled only when guard_numerics is armed; failing slots must be retired on host
-        finite = np.asarray(self._jit_finite(logits))
         ok = [(slot, req) for slot, req in running if bool(finite[slot])]
         for slot, req in running:
-            if not bool(finite[slot]):
+            if not bool(finite[slot]) and \
+                    req.state is RequestState.RUNNING:
                 self._fail_slot(req, FinishReason.NUMERICAL_ERROR)
         return ok
 
@@ -1588,7 +1736,9 @@ class ServingEngine:
             self._ensure_decode_pages(1)
         running = [(slot, req) for slot, req in self._slot_req.items()
                    if req.state is RequestState.RUNNING]
-        tokens = jnp.asarray(self._current[:, None])
+        # device twin of the current-token vector: decode never waits for
+        # the previous step's sampled tokens to round-trip the host
+        tokens = self._cur_dev[:, None]
         pos = jnp.asarray(self.pool.positions())
         with self.tracer.span("serving/decode", live=len(running)):
             if self._paged:
@@ -1599,7 +1749,9 @@ class ServingEngine:
         if self.faults is not None:
             logits, _ = self.faults.corrupt_logits(
                 logits, [slot for slot, _ in running])
-        running = self._guard_logits(logits, running)
+        # dispatch the finite check; the (B,) bool rides the step fetch
+        finite_dev = (self._jit_finite(logits)
+                      if self._jit_finite is not None and running else None)
         if not self._paged:
             self.pool.cache = cache
         if self._prefill_queue:
@@ -1614,17 +1766,33 @@ class ServingEngine:
         else:
             self.pool.advance(1)
         with self.tracer.span("serving/sample"):
-            nxt = self._sample(logits)  # host sync: tokens exist
-        emitted = 0
-        for slot, req in running:
-            token = int(nxt[slot])
-            req.output_tokens.append(token)
-            self._current[slot] = token
-            emitted += 1
-            self._maybe_retire(req, token, finished)
-        self._tokens_emitted += emitted
-        self.metrics.record_decode_step(emitted, len(running),
-                                        step_s=self._now() - t0)
+            nxt_dev = self._sample_dev(logits)
+        # full-batch overwrite: every row's next current token IS this
+        # decode's sample for that row (non-running rows hold garbage a
+        # masked decode row can never surface, and any later admission
+        # scatter overwrites them)
+        self._cur_dev = nxt_dev
+
+        def _on_decode(nxt, finite=None, running=running):
+            live = self._guard_rows(finite, running)
+            emitted = 0
+            for slot, req in live:
+                if req.state is not RequestState.RUNNING:
+                    # retired by an earlier replay in this same drain
+                    # (e.g. an admission token hit EOS); its decode row
+                    # was masked padding
+                    continue
+                token = int(nxt[slot])
+                req.output_tokens.append(token)
+                self._current[slot] = token
+                emitted += 1
+                self._maybe_retire(req, token, finished)
+            self._tokens_emitted += emitted
+            self.metrics.record_decode_step(emitted, len(running),
+                                            step_s=self._now() - t0)
+
+        self._defer([nxt_dev] if finite_dev is None
+                    else [nxt_dev, finite_dev], _on_decode)
 
     def _spec_decode_step(self, finished: List[Request], t0: float) -> None:
         """Draft K tokens per live slot, verify them all in ONE fixed-shape
@@ -1653,6 +1821,14 @@ class ServingEngine:
             draft_len = np.zeros((B,), np.int32)
             t_draft = 0.0
         else:
+            if self._deferred:
+                # admissions sampled first tokens earlier THIS step (the
+                # serial-order path): the drafter's host-side histories
+                # need them, so settle the queue now. Steady-state decode
+                # steps — and overlap mode, which dispatches spec before
+                # admissions — never take this early drain, keeping the
+                # hot loop at exactly one sync per step.
+                self._drain_deferred()
             histories: List[Optional[np.ndarray]] = [None] * B
             for slot, req in self._slot_req.items():
                 if req.state is RequestState.RUNNING:
@@ -1663,57 +1839,66 @@ class ServingEngine:
             draft_len = np.clip(np.asarray(draft_len, np.int32), 0, K)
             t_draft = self._now() - t0
 
-        tokens = np.concatenate([self._current[:, None], draft], axis=1)
+        # device twin feeds verify directly — no host round-trip for the
+        # previous step's tokens
+        tokens = jnp.concatenate(
+            [self._cur_dev[:, None], jnp.asarray(draft)], axis=1)
         self._rng, sub = jax.random.split(self._rng)
         with self.tracer.span("serving/verify_k", k=K):
             if self._paged:
-                out, n_emit = self.pool.run_verify(
-                    eng, jnp.asarray(tokens),
+                out_dev, n_emit_dev = self.pool.run_verify(
+                    eng, tokens,
                     jnp.asarray(self.pool.positions()), jnp.asarray(draft),
                     jnp.asarray(draft_len), sub,
                     jnp.asarray(self.temperature, jnp.float32),
                     self._greedy, int(self.top_k), float(self.top_p))
             else:
-                cache, out, n_emit = eng.verify_k(
-                    self.pool.cache, jnp.asarray(tokens),
+                cache, out_dev, n_emit_dev = eng.verify_k(
+                    self.pool.cache, tokens,
                     jnp.asarray(self.pool.positions()), jnp.asarray(draft),
                     jnp.asarray(draft_len), sub,
                     jnp.asarray(self.temperature, jnp.float32),
                     self._greedy, int(self.top_k), float(self.top_p))
                 self.pool.cache = cache
-        with self.tracer.span("serving/sample"):
-            # host sync: accepted tokens exist
-            # graftlint: allow[hot-loop-host-sync] -- the verify sync is spec decode's one deliberate hop: accepted tokens extend requests on host
-            out = np.asarray(out)       # (B, K+1) emitted tokens per row
-            n_emit = np.asarray(n_emit)  # graftlint: allow[hot-loop-host-sync] -- same deliberate verify sync, (B,) accept counts
-
-        deltas = np.zeros((B,), np.int32)
-        emitted = drafted = accepted = 0
+        # next step's current token per row is the last EMITTED one:
+        # out[b, n_emit[b]-1] (n_emit >= 1 always for live rows)
+        self._cur_dev = self._jit_spec_cur(out_dev, n_emit_dev)
         live = [(slot, req) for slot, req in self._slot_req.items()
                 if req.state is RequestState.RUNNING]
-        for slot, req in live:
-            e = int(n_emit[slot])
-            # the cache row holds e new positions regardless of how many
-            # tokens the request actually consumes below: if eos/budget
-            # truncates the emission, the request retires this step, so
-            # the surplus becomes dead padding in a freed slot
-            deltas[slot] = e
-            drafted += int(draft_len[slot])
-            accepted += e - 1
-            req.spec_drafted += int(draft_len[slot])
-            req.spec_accepted += e - 1
-            for token in out[slot, :e].tolist():
-                req.output_tokens.append(token)
-                self._current[slot] = token
-                emitted += 1
-                self._maybe_retire(req, token, finished)
+
+        def _on_verify(out, n_emit, live=live, draft_len=draft_len):
+            deltas = np.zeros((B,), np.int32)
+            emitted = drafted = accepted = 0
+            for slot, req in live:
                 if req.state is not RequestState.RUNNING:
-                    break
-        self.pool.advance(deltas)      # per-slot KV rollback
-        self._tokens_emitted += emitted
-        self.metrics.record_decode_step(emitted, len(live), drafted=drafted,
-                                        accepted=accepted, draft_s=t_draft,
-                                        step_s=self._now() - t0)
+                    # retired by an earlier replay in this same drain;
+                    # its verify row was masked padding
+                    continue
+                e = int(n_emit[slot])
+                # the cache row holds e new positions regardless of how
+                # many tokens the request actually consumes below: if
+                # eos/budget truncates the emission, the request retires
+                # this step, so the surplus becomes dead padding in a
+                # freed slot
+                deltas[slot] = e
+                drafted += int(draft_len[slot])
+                accepted += e - 1
+                req.spec_drafted += int(draft_len[slot])
+                req.spec_accepted += e - 1
+                for token in out[slot, :e].tolist():
+                    req.output_tokens.append(token)
+                    self._current[slot] = token
+                    emitted += 1
+                    self._maybe_retire(req, token, finished)
+                    if req.state is not RequestState.RUNNING:
+                        break
+            self.pool.advance(deltas)      # per-slot KV rollback
+            self._tokens_emitted += emitted
+            self.metrics.record_decode_step(
+                emitted, len(live), drafted=drafted, accepted=accepted,
+                draft_s=t_draft, step_s=self._now() - t0)
+
+        self._defer([out_dev, n_emit_dev], _on_verify)
 
     def _abort_step(self, granted: List[Request]) -> None:
         """Mid-step exception recovery: never leak a slot. Requests the
@@ -1753,6 +1938,13 @@ class ServingEngine:
                                   reason=FinishReason.ERROR.value)
         self._slot_req.clear()
         self._current[:] = 0
+        # drop queued-but-unfetched host bookkeeping: its device arrays
+        # belong to the aborted step's state, and its requests are now
+        # FAILED/requeued either way
+        self._deferred.clear()
+        self._cur_dev = jax.device_put(
+            np.zeros((self.pool.num_slots,), np.int32),
+            self._rep_sharding())
         self.pool.reset()
 
     def run_until_drained(self, max_steps: Optional[int] = None,

@@ -71,6 +71,12 @@ class PagePoolExhausted(RuntimeError):
     victim (freeing its pages) and retry, or fail the allocation."""
 
 
+#: mirror of ``ops.attention.paged_attention.MAX_QUERY_ROWS`` as a local
+#: literal so graftcheck can decide the verify-width gate statically;
+#: ``bind_engine`` asserts the two stay equal
+_KERNEL_MAX_QUERY_ROWS = 8
+
+
 class PagedKVPool(SlotPool):
     """Drop-in :class:`SlotPool` with paged storage and prefix caching.
 
@@ -85,7 +91,10 @@ class PagedKVPool(SlotPool):
 
     def __init__(self, spec: Any, num_slots: int,
                  num_pages: Optional[int] = None, page_size: int = 64,
-                 sharding: Any = None, prefix_cache: bool = True):
+                 sharding: Any = None, prefix_cache: bool = True,
+                 kernel: str = "auto"):
+        if kernel not in ("auto", "on", "off"):
+            raise ValueError(f"kernel must be auto|on|off, got {kernel!r}")
         capacity = int(spec.max_seq_len)
         page_size = int(page_size)
         if page_size < 1:
@@ -119,6 +128,16 @@ class PagedKVPool(SlotPool):
         self._paged_decode_jit = None
         self._paged_verify_jit = None
         self._paged_chunk_jit = None
+        # fused paged-attention kernel selection (ISSUE 13): "off" keeps
+        # the gather→dense-attention→scatter composition everywhere;
+        # "on" forces the in-place page-table kernel (interpret mode
+        # off-TPU — the bitwise-parity/CI configuration); "auto" uses
+        # the kernel on TPU only. The dense composition remains the
+        # oracle and fallback either way (chunked prefill always uses
+        # it — chunk widths exceed the kernel's query-row limit).
+        self.kernel = kernel
+        self._paged_decode_kernel_jit = None
+        self._paged_verify_kernel_jit = None
         self._jit_copy_page = jax.jit(self._copy_page_body,
                                       donate_argnums=(0,))
         self._admit_rows_jit = jax.jit(self._paged_admit_rows,
@@ -483,6 +502,55 @@ class PagedKVPool(SlotPool):
                                          static_argnums=(9, 10))
         self._paged_chunk_jit = (jax.jit(paged_chunk, donate_argnums=(1,))
                                  if chunk_gen is not None else None)
+
+        # -- fused paged-attention kernel entries (ISSUE 13) -----------
+        # Same jit signatures as the dense compositions above, but the
+        # model step runs ``decode_paged``: column writes scatter through
+        # the page table at the source and the Pallas kernel reads pages
+        # in place — the dense (L, B, KV, cd, S) scratch view is never
+        # built. Greedy decode output is bitwise-identical (the kernel's
+        # per-page online-softmax blocking matches decode_attention at
+        # block_s=page_size; see ops/attention/paged_attention.py).
+        if self.kernel_active \
+                and getattr(module, "decode_paged", None) is not None:
+            from ..ops.attention.paged_attention import MAX_QUERY_ROWS
+            if MAX_QUERY_ROWS != _KERNEL_MAX_QUERY_ROWS:
+                raise RuntimeError(
+                    f"_KERNEL_MAX_QUERY_ROWS={_KERNEL_MAX_QUERY_ROWS} "
+                    f"drifted from kernel MAX_QUERY_ROWS={MAX_QUERY_ROWS}")
+
+            def kernel_decode_fn(params, cache, token, pos):
+                cs = cache["cache_store"]
+                vals = {k: v for k, v in cs.items() if k != "table"}
+                logits, vars_ = module.apply(
+                    {"params": dequant(params),
+                     "cache": {"cache_store": vals}},
+                    token, pos, cs["table"], method=module.decode_paged,
+                    mutable=["cache"])
+                new = dict(vars_["cache"]["cache_store"])
+                new["table"] = cs["table"]
+                return logits, {"cache_store": new}
+
+            def kernel_decode(params, cs, token, pos):
+                logits, new = kernel_decode_fn(params,
+                                               {"cache_store": cs},
+                                               token, pos)
+                return logits, new["cache_store"]
+
+            kernel_verify_body = make_verify_fn(kernel_decode_fn,
+                                                _filter_logits)
+
+            def kernel_verify(params, cs, tokens, pos, draft, draft_len,
+                              rng, temperature, greedy, top_k, top_p):
+                new, out_tok, n_emit = kernel_verify_body(
+                    params, {"cache_store": cs}, tokens, pos, draft,
+                    draft_len, rng, temperature, greedy, top_k, top_p)
+                return new["cache_store"], out_tok, n_emit
+
+            self._paged_decode_kernel_jit = jax.jit(kernel_decode,
+                                                    donate_argnums=(1,))
+            self._paged_verify_kernel_jit = jax.jit(
+                kernel_verify, donate_argnums=(1,), static_argnums=(9, 10))
         # pre-compile the CoW copy program with a no-op self-copy: the
         # first real fork can land arbitrarily late (a prefix hit on a
         # page some earlier request published), easily after warmup
@@ -495,24 +563,57 @@ class PagedKVPool(SlotPool):
     # ------------------------------------------------------------------
     # jitted entry points (the serving engine dispatches here when paged)
     # ------------------------------------------------------------------
+    @property
+    def kernel_active(self) -> bool:
+        """Whether decode/verify dispatch to the fused paged-attention
+        kernel ("on": always, interpret mode off-TPU; "auto": TPU only;
+        "off": never — dense gather/scatter composition everywhere)."""
+        if self.kernel == "off":
+            return False
+        if self.kernel == "on":
+            return True
+        return jax.default_backend() == "tpu"
+
     def run_decode(self, engine: Any, tokens, pos):
         """One masked decode step for every slot over paged storage;
         updates the pool state in place and returns the logits."""
         self.bind_engine(engine)
-        logits, cs = self._paged_decode_jit(
-            engine.params, self.cache["cache_store"], tokens, pos)
-        self.cache = {"cache_store": cs}
+        # direct attribute dispatch on both arms (not `fn = a or b;
+        # fn(...)`): the watchdog and graftcheck identify watched
+        # programs by the attribute the call goes through; each arm
+        # rebinds self.cache immediately — its cache operand is donated
+        if self._paged_decode_kernel_jit is not None:
+            logits, cs = self._paged_decode_kernel_jit(
+                engine.params, self.cache["cache_store"], tokens, pos)
+            self.cache = {"cache_store": cs}
+        else:
+            logits, cs = self._paged_decode_jit(
+                engine.params, self.cache["cache_store"], tokens, pos)
+            self.cache = {"cache_store": cs}
         return logits
 
     def run_verify(self, engine: Any, tokens, pos, draft, draft_len, rng,
                    temperature, greedy, top_k: int, top_p: float):
         """Speculative verify over paged storage (same semantics as
-        ``InferenceEngine.verify_k``); returns ``(out, n_emit)``."""
+        ``InferenceEngine.verify_k``); returns ``(out, n_emit)``. The
+        fused kernel handles K+1 query rows up to its sublane-tile limit
+        (``_KERNEL_MAX_QUERY_ROWS``); wider verify chunks stay on the
+        dense composition."""
         self.bind_engine(engine)
-        cs, out, n_emit = self._paged_verify_jit(
-            engine.params, self.cache["cache_store"], tokens, pos, draft,
-            draft_len, rng, temperature, greedy, int(top_k), float(top_p))
-        self.cache = {"cache_store": cs}
+        use_kernel = self._paged_verify_kernel_jit is not None \
+            and tokens.shape[1] <= _KERNEL_MAX_QUERY_ROWS
+        if use_kernel:
+            cs, out, n_emit = self._paged_verify_kernel_jit(
+                engine.params, self.cache["cache_store"], tokens, pos,
+                draft, draft_len, rng, temperature, greedy, int(top_k),
+                float(top_p))
+            self.cache = {"cache_store": cs}
+        else:
+            cs, out, n_emit = self._paged_verify_jit(
+                engine.params, self.cache["cache_store"], tokens, pos,
+                draft, draft_len, rng, temperature, greedy, int(top_k),
+                float(top_p))
+            self.cache = {"cache_store": cs}
         return out, n_emit
 
     def run_prefill_chunk(self, engine: Any, ids, slot: int, start: int,

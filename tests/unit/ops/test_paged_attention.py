@@ -1,0 +1,234 @@
+"""Fused paged-attention decode kernel tests (ISSUE 13). The parity
+contract under test: a single-token call is BITWISE identical to
+``decode_attention`` over the gathered dense view with ``block_s`` pinned
+to the page size — paging is an addressing change, never a numerics
+change — and garbage pages (unmapped sentinels, stale contents past the
+live length) can never reach the output. Pallas runs in interpreter mode
+on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention.decode_attention import decode_attention
+from deepspeed_tpu.ops.attention.flash_attention import SUBLANES
+from deepspeed_tpu.ops.attention.paged_attention import (
+    MAX_QUERY_ROWS,
+    paged_decode_attention,
+)
+
+
+def _make_paged(rng, B, KV, D, S, ps, n_free=2, dtype=np.float32):
+    """Random dense positions-minor cache (B, KV, D, S) cut into pages at
+    a random physical placement. Returns (dense_k, dense_v, k_pages,
+    v_pages, table); ``n_free`` extra physical pages stay unmapped so the
+    permutation is non-trivial."""
+    pages_per_slot = S // ps
+    P = B * pages_per_slot + n_free
+    dense_k = rng.standard_normal((B, KV, D, S)).astype(dtype)
+    dense_v = rng.standard_normal((B, KV, D, S)).astype(dtype)
+    perm = rng.permutation(P)[:B * pages_per_slot]
+    table = perm.reshape(B, pages_per_slot).astype(np.int32)
+    k_pages = np.zeros((P, KV, D, ps), dtype)
+    v_pages = np.zeros((P, KV, D, ps), dtype)
+    for b in range(B):
+        for j in range(pages_per_slot):
+            k_pages[table[b, j]] = dense_k[b, :, :, j * ps:(j + 1) * ps]
+            v_pages[table[b, j]] = dense_v[b, :, :, j * ps:(j + 1) * ps]
+    return dense_k, dense_v, k_pages, v_pages, table
+
+
+@pytest.mark.parametrize("B,H,KV,D,S,ps", [
+    (2, 4, 4, 64, 128, 32),     # MHA
+    (2, 8, 2, 64, 128, 16),     # GQA 4x
+    (1, 4, 1, 128, 256, 64),    # MQA
+])
+def test_decode_bitwise_matches_dense_oracle(B, H, KV, D, S, ps):
+    """T=1 decode: bitwise-equal to the dense kernel at block_s=ps on
+    the gathered view (the serving pool's dense-composition oracle),
+    including non-power-of-two live lengths."""
+    rng = np.random.default_rng(0)
+    dense_k, dense_v, k_pages, v_pages, table = _make_paged(
+        rng, B, KV, D, S, ps)
+    # non-pow2, page-straddling starts; one slot with a single live token
+    starts = np.asarray([0, S - ps - 3][:B], np.int32) \
+        if B == 2 else np.asarray([S // 2 - 5], np.int32)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+
+    out = paged_decode_attention(q, jnp.asarray(k_pages),
+                                 jnp.asarray(v_pages), jnp.asarray(table),
+                                 jnp.asarray(starts))
+    oracle = decode_attention(q[:, 0], jnp.asarray(dense_k),
+                              jnp.asarray(dense_v),
+                              jnp.asarray(starts + 1), block_s=ps)
+    assert out.shape == (B, 1, H, D)
+    np.testing.assert_array_equal(np.asarray(out[:, 0]), np.asarray(oracle))
+
+
+def test_garbage_pages_and_sentinels_never_reach_output():
+    """Dead table entries (sentinel = P) and garbage in unmapped / past-
+    length pages must not change a single output bit — masking is by
+    length, and dead grid steps clamp to the last live page."""
+    rng = np.random.default_rng(1)
+    B, H, KV, D, S, ps = 2, 4, 2, 64, 128, 32
+    _, _, k_pages, v_pages, table = _make_paged(rng, B, KV, D, S, ps)
+    starts = np.asarray([ps + 5, 2 * ps - 1], np.int32)  # 2 live pages each
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    P = k_pages.shape[0]
+
+    clean = paged_decode_attention(q, jnp.asarray(k_pages),
+                                   jnp.asarray(v_pages), jnp.asarray(table),
+                                   jnp.asarray(starts))
+
+    # poison every page past each slot's live range and point the dead
+    # table entries at the unmapped sentinel (the pool's discipline for
+    # freed pages); large-but-finite garbage — exp(NEG_INF - m) == 0
+    # exactly, so masked columns contribute exactly nothing
+    dirty_k, dirty_v, dirty_t = (k_pages.copy(), v_pages.copy(),
+                                 table.copy())
+    live_pages = (starts + 1 + ps - 1) // ps
+    mapped_live = {int(table[b, j])
+                   for b in range(B) for j in range(live_pages[b])}
+    for p in range(P):
+        if p not in mapped_live:
+            dirty_k[p] = 1e4
+            dirty_v[p] = -1e4
+    for b in range(B):
+        dirty_t[b, live_pages[b]:] = P          # unmapped sentinel
+    # stale columns past the live length INSIDE the last live page too
+    for b in range(B):
+        last = int(table[b, live_pages[b] - 1])
+        col = (starts[b] + 1) % ps
+        if col:
+            dirty_k[last, :, :, col:] = 1e4
+            dirty_v[last, :, :, col:] = -1e4
+
+    dirty = paged_decode_attention(q, jnp.asarray(dirty_k),
+                                   jnp.asarray(dirty_v),
+                                   jnp.asarray(dirty_t),
+                                   jnp.asarray(starts))
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(dirty))
+
+
+def _reference_rows(q, dense_k, dense_v, starts):
+    """Plain fp32 softmax reference with per-row causal limits: row t of
+    slot b attends cache positions [0, starts[b] + t]."""
+    B, T, H, D = q.shape
+    _, KV, _, S = dense_k.shape
+    rep = H // KV
+    k = np.repeat(dense_k, rep, axis=1)          # (B, H, D, S)
+    v = np.repeat(dense_v, rep, axis=1)
+    s = np.einsum("bthd,bhds->bths", q.astype(np.float64),
+                  k.astype(np.float64)) / np.sqrt(D)
+    pos = np.arange(S)[None, None, None, :]
+    limit = (starts[:, None, None, None]
+             + np.arange(T)[None, :, None, None])
+    s = np.where(pos <= limit, s, -np.inf)
+    p = np.exp(s - s.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    return np.einsum("bths,bhds->bthd", p, v.astype(np.float64))
+
+
+@pytest.mark.parametrize("T", [2, 3, MAX_QUERY_ROWS])
+def test_multi_row_verify_matches_reference(T):
+    """T>1 (speculative verify): each query row carries its own causal
+    limit; numerics match a plain-softmax reference."""
+    rng = np.random.default_rng(2)
+    B, H, KV, D, S, ps = 2, 4, 2, 64, 128, 16
+    dense_k, dense_v, k_pages, v_pages, table = _make_paged(
+        rng, B, KV, D, S, ps)
+    starts = np.asarray([ps - 1, 3 * ps + 2], np.int32)  # straddle pages
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    out = paged_decode_attention(q, jnp.asarray(k_pages),
+                                 jnp.asarray(v_pages), jnp.asarray(table),
+                                 jnp.asarray(starts))
+    ref = _reference_rows(np.asarray(q), dense_k, dense_v, starts)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4, rtol=1e-4)
+
+
+def test_row_budget_is_enforced():
+    assert MAX_QUERY_ROWS == SUBLANES
+    rng = np.random.default_rng(3)
+    B, H, KV, D, S, ps = 1, 2, 2, 64, 64, 16
+    _, _, k_pages, v_pages, table = _make_paged(rng, B, KV, D, S, ps)
+    q = jnp.asarray(
+        rng.standard_normal((B, MAX_QUERY_ROWS + 1, H, D)), jnp.float32)
+    with pytest.raises(AssertionError, match="query rows"):
+        paged_decode_attention(q, jnp.asarray(k_pages),
+                               jnp.asarray(v_pages), jnp.asarray(table),
+                               jnp.asarray([5], np.int32))
+
+
+def test_alibi_matches_dense_oracle():
+    rng = np.random.default_rng(4)
+    B, H, KV, D, S, ps = 2, 4, 4, 64, 128, 32
+    dense_k, dense_v, k_pages, v_pages, table = _make_paged(
+        rng, B, KV, D, S, ps)
+    starts = np.asarray([40, 97], np.int32)
+    slopes = jnp.asarray(rng.standard_normal(H) * 0.1, jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    out = paged_decode_attention(q, jnp.asarray(k_pages),
+                                 jnp.asarray(v_pages), jnp.asarray(table),
+                                 jnp.asarray(starts), alibi_slopes=slopes)
+    oracle = decode_attention(q[:, 0], jnp.asarray(dense_k),
+                              jnp.asarray(dense_v),
+                              jnp.asarray(starts + 1),
+                              alibi_slopes=slopes, block_s=ps)
+    np.testing.assert_array_equal(np.asarray(out[:, 0]), np.asarray(oracle))
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_quantized_pages_match_dense_oracle(packed):
+    """int8 (and int32-packed) page pools with per-column scales: bitwise
+    against the dense quantized kernel on the gathered view."""
+    from deepspeed_tpu.ops.attention.decode_attention import (
+        pack_int8_sublanes,
+    )
+
+    rng = np.random.default_rng(5)
+    B, H, KV, D, S, ps = 2, 4, 2, 64, 128, 32
+    pages_per_slot = S // ps
+    P = B * pages_per_slot + 2
+    k8 = rng.integers(-127, 128, (P, KV, D, ps)).astype(np.int8)
+    v8 = rng.integers(-127, 128, (P, KV, D, ps)).astype(np.int8)
+    ks = rng.uniform(0.01, 0.1, (P, KV, ps)).astype(np.float32)
+    vs = rng.uniform(0.01, 0.1, (P, KV, ps)).astype(np.float32)
+    perm = rng.permutation(P)[:B * pages_per_slot]
+    table = perm.reshape(B, pages_per_slot).astype(np.int32)
+
+    def gather(pages):
+        # (B, KV, ..., S) dense view through the table
+        return np.concatenate([pages[table[:, j]]
+                               for j in range(pages_per_slot)], axis=-1)
+
+    starts = np.asarray([S - 3, ps + 7], np.int32)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    kp, vp = (jnp.asarray(k8), jnp.asarray(v8))
+    dk, dv = (jnp.asarray(gather(k8)), jnp.asarray(gather(v8)))
+    if packed:
+        kp, vp = pack_int8_sublanes(kp), pack_int8_sublanes(vp)
+        dk, dv = pack_int8_sublanes(dk), pack_int8_sublanes(dv)
+    out = paged_decode_attention(
+        q, kp, vp, jnp.asarray(table), jnp.asarray(starts),
+        k_scale_pages=jnp.asarray(ks), v_scale_pages=jnp.asarray(vs))
+    oracle = decode_attention(
+        q[:, 0], dk, dv, jnp.asarray(starts + 1),
+        k_scale=jnp.asarray(gather(ks)), v_scale=jnp.asarray(gather(vs)),
+        block_s=ps)
+    np.testing.assert_array_equal(np.asarray(out[:, 0]), np.asarray(oracle))
+
+
+def test_jit_and_eager_agree():
+    """The kernel under jit (how the pool always calls it) is the same
+    function it is eagerly — no trace-time shape surprises."""
+    rng = np.random.default_rng(6)
+    B, H, KV, D, S, ps = 2, 2, 2, 64, 64, 16
+    _, _, k_pages, v_pages, table = _make_paged(rng, B, KV, D, S, ps)
+    starts = np.asarray([9, 31], np.int32)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    args = (q, jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(table), jnp.asarray(starts))
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(paged_decode_attention)(*args)),
+        np.asarray(paged_decode_attention(*args)))

@@ -1,0 +1,179 @@
+"""Pipelined-step (``overlap=True``) tests (ISSUE 13): overlap reorders
+WHEN host bookkeeping happens — decode dispatches before admission's host
+work, token fetches collapse onto one end-of-step sync — but never WHAT
+is computed. Every outcome (tokens, finish reasons, terminal timeline
+events) must be bitwise what the serial step produces, across the plain,
+paged-kernel and speculative configurations, including preempt/resume;
+the deferred-fetch queue must always drain by the step boundary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer_lm import TransformerConfig, TransformerLM
+from deepspeed_tpu.serving import RequestState, ServingEngine
+
+TINY = dict(vocab_size=64, max_seq_len=64, n_embd=32, n_layer=2, n_head=4,
+            dtype=jnp.float32)
+PS = 8
+
+
+@pytest.fixture(scope="module")
+def stack():
+    cfg = TransformerConfig(**TINY)
+    model = TransformerLM(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, 64)
+    params = model.init({"params": jax.random.PRNGKey(1)}, ids,
+                        method=model.logits)["params"]
+    engine = ds.init_inference(model=model, model_parameters=params,
+                               config={"dtype": "float32"})
+    return model, params, engine
+
+
+def make_srv(engine, overlap, num_slots=3, **kw):
+    kw.setdefault("prefill_chunk", PS)
+    return ServingEngine(engine, num_slots=num_slots, max_queue_depth=32,
+                         overlap=overlap, **kw)
+
+
+def _workload(seed=11, n=8):
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(3, 22, size=n)
+    prompts = [rng.integers(0, 64, size=int(T)).astype(np.int32)
+               for T in lengths]
+    budgets = [int(b) for b in rng.integers(3, 10, size=n)]
+    return prompts, budgets
+
+
+def run_traffic(srv, prompts, budgets, max_steps=600):
+    reqs = [srv.submit(p, max_new_tokens=b) for p, b in zip(prompts, budgets)]
+    srv.run_until_drained(max_steps=max_steps)
+    srv.check_invariants()
+    assert not srv._deferred, "deferred fetches leaked past the drain"
+    return reqs
+
+
+@pytest.mark.parametrize("extra", [
+    {},
+    {"paged_kv": {"page_size": PS, "kernel": "on"}},
+    {"spec_decode": {"k": 3, "drafter": "ngram"}},
+], ids=["plain", "paged-kernel", "spec"])
+def test_overlap_outcome_parity(stack, extra):
+    """Same staggered workload through overlap and serial servers: every
+    request must finish with identical tokens, identical finish reason,
+    and identical first/terminal timeline events."""
+    _, _, engine = stack
+    prompts, budgets = _workload()
+    srv_s = make_srv(engine, overlap=False, **extra)
+    srv_o = make_srv(engine, overlap=True, **extra)
+    assert not srv_s._overlap and srv_o._overlap
+    serial = run_traffic(srv_s, prompts, budgets)
+    over = run_traffic(srv_o, prompts, budgets)
+    for a, b in zip(serial, over):
+        assert a.state == RequestState.FINISHED, a.finish_reason
+        assert a.finish_reason == b.finish_reason
+        np.testing.assert_array_equal(a.tokens(), b.tokens())
+        ev_a = srv_s.timelines.events_of(a.request_id)
+        ev_b = srv_o.timelines.events_of(b.request_id)
+        assert ev_a[0] == ev_b[0] and ev_a[-1] == ev_b[-1]
+
+
+def test_overlap_matches_generate(stack):
+    """The pipelined path against the whole-batch oracle directly."""
+    _, _, engine = stack
+    prompts, budgets = _workload(seed=17, n=5)
+    reqs = run_traffic(make_srv(engine, overlap=True), prompts, budgets)
+    for req, p, b in zip(reqs, prompts, budgets):
+        expected = engine.generate(np.asarray(p)[None],
+                                   max_new_tokens=b)[0]
+        np.testing.assert_array_equal(req.tokens(), expected)
+
+
+def test_overlap_preempt_resume_parity(stack):
+    """Preempting mid-decode while fetches are deferred: the rollback
+    must observe fully-drained host state (no token applied twice, none
+    lost) — the resumed request's output equals the serial arm's."""
+    _, _, engine = stack
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, 64, size=14).astype(np.int32)
+
+    def run(overlap):
+        srv = make_srv(engine, overlap=overlap, num_slots=2)
+        req = srv.submit(prompt, max_new_tokens=10)
+        for _ in range(4):
+            srv.step()
+        assert not srv._deferred          # step boundaries stay clean
+        srv.preempt(req.request_id)
+        assert req.preemptions == 1
+        srv.run_until_drained(max_steps=200)
+        srv.check_invariants()
+        return req
+
+    a, b = run(True), run(False)
+    assert a.state == RequestState.FINISHED
+    assert a.finish_reason == b.finish_reason
+    np.testing.assert_array_equal(a.tokens(), b.tokens())
+
+
+def test_overlap_defers_decode_fetches(stack):
+    """The pipeline is real, not vacuous: with live decode slots, an
+    overlap step queues its token fetches through _defer and drains them
+    exactly once at the step boundary (the ONE deliberate sync)."""
+    _, _, engine = stack
+    srv = make_srv(engine, overlap=True, num_slots=2)
+    drains, queued = [], []
+    orig = srv._drain_deferred
+
+    def spy(**kw):
+        queued.append(len(srv._deferred))
+        drains.append(kw)
+        return orig(**kw)
+
+    srv._drain_deferred = spy
+    srv.submit(np.arange(6, dtype=np.int32), max_new_tokens=4)
+    srv.run_until_drained(max_steps=100)
+    srv._drain_deferred = orig
+    srv.check_invariants()
+    # at least one decode step queued a deferred fetch before draining
+    assert any(n > 0 for n in queued)
+
+
+def test_init_serving_forwards_overlap_and_kernel(stack):
+    """`ds.init_serving(overlap=..., paged_kv={"kernel": ...})` must reach
+    the ServingEngine, not leak into the inference-engine kwargs."""
+    model, params, _ = stack
+    srv = ds.init_serving(model=model, model_parameters=params,
+                          config={"dtype": "float32"}, num_slots=2,
+                          prefill_chunk=PS, overlap=True,
+                          paged_kv={"page_size": PS, "kernel": "on"})
+    assert srv._overlap
+    assert srv.pool.kernel_active
+    req = srv.submit(np.arange(5, dtype=np.int32), max_new_tokens=3)
+    srv.run_until_drained(max_steps=100)
+    srv.check_invariants()
+    assert req.state == RequestState.FINISHED
+
+
+def test_overlap_cancel_midflight(stack):
+    """Cancel while a fetch may be in flight: the slot frees, invariants
+    hold, and the other request's tokens are untouched."""
+    _, _, engine = stack
+    rng = np.random.default_rng(29)
+    keep_p = rng.integers(0, 64, size=9).astype(np.int32)
+    srv = make_srv(engine, overlap=True, num_slots=2)
+    keep = srv.submit(keep_p, max_new_tokens=6)
+    kill = srv.submit(rng.integers(0, 64, size=12).astype(np.int32),
+                      max_new_tokens=20)
+    for _ in range(3):
+        srv.step()
+    srv.cancel(kill.request_id)
+    srv.run_until_drained(max_steps=100)
+    srv.check_invariants()
+    assert not srv._deferred
+    assert keep.state == RequestState.FINISHED
+    expected = engine.generate(np.asarray(keep_p)[None],
+                               max_new_tokens=6)[0]
+    np.testing.assert_array_equal(keep.tokens(), expected)
+    assert kill.state != RequestState.RUNNING

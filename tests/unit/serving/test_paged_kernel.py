@@ -1,0 +1,201 @@
+"""Paged-kernel serving tests (ISSUE 13): the fused Pallas paged-attention
+path (``paged_kv={"kernel": "on"}``) must be a pure EXECUTABLE change —
+greedy tokens bitwise-match the dense gather/scatter oracle (``"off"``)
+and whole-batch ``generate()`` under slot churn, speculative rollback,
+and preempt/resume; the kernel knob is validated and backend-gated; page
+churn through the kernel never recompiles after warmup."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer_lm import TransformerConfig, TransformerLM
+from deepspeed_tpu.serving import PagedKVPool, RequestState, ServingEngine
+
+TINY = dict(vocab_size=64, max_seq_len=64, n_embd=32, n_layer=2, n_head=4,
+            dtype=jnp.float32)
+PS = 8  # page size == prefill chunk for every server in this file
+
+
+@pytest.fixture(scope="module")
+def stack():
+    cfg = TransformerConfig(**TINY)
+    model = TransformerLM(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, 64)
+    params = model.init({"params": jax.random.PRNGKey(1)}, ids,
+                        method=model.logits)["params"]
+    engine = ds.init_inference(model=model, model_parameters=params,
+                               config={"dtype": "float32"})
+    return model, params, engine
+
+
+def kernel_server(engine, kernel="on", num_slots=2, **kw):
+    kw.setdefault("prefill_chunk", PS)
+    return ServingEngine(engine, num_slots=num_slots, max_queue_depth=32,
+                         paged_kv={"page_size": PS, "kernel": kernel}, **kw)
+
+
+def run_traffic(srv, prompts, budgets, max_steps=400):
+    reqs = [srv.submit(p, max_new_tokens=b) for p, b in zip(prompts, budgets)]
+    srv.run_until_drained(max_steps=max_steps)
+    srv.check_invariants()
+    return reqs
+
+
+def _mixed_workload(seed=7, n=6):
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(3, 22, size=n)
+    prompts = [rng.integers(0, 64, size=int(T)).astype(np.int32)
+               for T in lengths]
+    budgets = [int(b) for b in rng.integers(3, 9, size=n)]
+    return prompts, budgets
+
+
+# ---------------------------------------------------------------------------
+# knob + gating
+
+
+def test_kernel_knob_validates_and_gates(stack):
+    _, _, engine = stack
+    srv_on = kernel_server(engine, "on")
+    assert isinstance(srv_on.pool, PagedKVPool)
+    assert srv_on.pool.kernel_active
+    assert srv_on.pool._paged_decode_kernel_jit is not None
+    srv_off = kernel_server(engine, "off")
+    assert not srv_off.pool.kernel_active
+    assert srv_off.pool._paged_decode_kernel_jit is None
+    # "auto" follows the backend: kernel only on real TPU hardware
+    srv_auto = kernel_server(engine, "auto")
+    expect = jax.default_backend() == "tpu"
+    assert srv_auto.pool.kernel_active == expect
+    with pytest.raises(ValueError, match="kernel"):
+        kernel_server(engine, "sometimes")
+
+
+def test_max_query_rows_drift_guard(stack, monkeypatch):
+    """The pool mirrors the kernel's row budget as a local literal (so
+    graftcheck can decide the verify gate statically); binding must
+    refuse to run if the two ever drift."""
+    import deepspeed_tpu.serving.paged_pool as pp
+
+    _, _, engine = stack
+    monkeypatch.setattr(pp, "_KERNEL_MAX_QUERY_ROWS", 4)
+    with pytest.raises(RuntimeError, match="MAX_QUERY_ROWS"):
+        kernel_server(engine, "on")
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity
+
+
+def test_kernel_tokens_bitwise_match_dense_and_generate(stack):
+    """Multi-wave slot churn through the fused kernel: per-request tokens
+    must equal the dense-oracle server's AND static-batch generate()'s,
+    bit for bit (greedy)."""
+    _, _, engine = stack
+    prompts, budgets = _mixed_workload()
+    on = run_traffic(kernel_server(engine, "on"), prompts, budgets)
+    off = run_traffic(kernel_server(engine, "off"), prompts, budgets)
+    for a, b, p, budget in zip(on, off, prompts, budgets):
+        assert a.state == RequestState.FINISHED, a.finish_reason
+        np.testing.assert_array_equal(a.tokens(), b.tokens())
+        expected = engine.generate(np.asarray(p)[None],
+                                   max_new_tokens=budget)[0]
+        np.testing.assert_array_equal(a.tokens(), expected)
+
+
+def test_kernel_spec_verify_parity_with_rollback(stack):
+    """Speculative decoding through the fused verify kernel: repetitive
+    prompts drive acceptances (multi-row verify widths), random ones
+    drive rejections (rollback across page boundaries); tokens must
+    bitwise-match the dense verify path either way."""
+    _, _, engine = stack
+    rng = np.random.default_rng(3)
+    motif = rng.integers(0, 64, size=5)
+    prompts = [np.tile(motif, 4).astype(np.int32),          # acceptances
+               rng.integers(0, 64, size=17).astype(np.int32),  # rejections
+               np.tile(motif, 3)[:-2].astype(np.int32)]
+    budgets = [8, 6, 9]
+    spec = {"k": 3, "drafter": "ngram"}
+
+    def run(kernel):
+        srv = kernel_server(engine, kernel, spec_decode=dict(spec))
+        return srv, run_traffic(srv, prompts, budgets)
+
+    srv_on, on = run("on")
+    assert srv_on.pool._paged_verify_kernel_jit is not None
+    _, off = run("off")
+    for a, b in zip(on, off):
+        np.testing.assert_array_equal(a.tokens(), b.tokens())
+    s = srv_on.stats()
+    assert s["spec_drafted"] > 0 and s["spec_accepted"] > 0
+
+
+def test_verify_width_beyond_row_budget_falls_back(stack):
+    """spec_k + 1 rows past MAX_QUERY_ROWS must fall back to the dense
+    verify composition (the kernel's row budget is the sublane count) —
+    with identical tokens, not an error."""
+    from deepspeed_tpu.ops.attention.paged_attention import MAX_QUERY_ROWS
+
+    _, _, engine = stack
+    k = MAX_QUERY_ROWS  # verify width k+1 exceeds the kernel budget
+    rng = np.random.default_rng(5)
+    motif = rng.integers(0, 64, size=4)
+    prompts = [np.tile(motif, 5).astype(np.int32)]
+    budgets = [10]
+    spec = {"k": k, "drafter": "ngram"}
+    srv_on = kernel_server(engine, "on", spec_decode=dict(spec))
+    assert srv_on.pool._paged_verify_kernel_jit is not None
+    on = run_traffic(srv_on, prompts, budgets)
+    off = run_traffic(kernel_server(engine, "off",
+                                    spec_decode=dict(spec)),
+                      prompts, budgets)
+    np.testing.assert_array_equal(on[0].tokens(), off[0].tokens())
+
+
+def test_kernel_preempt_resume_parity(stack):
+    """Preempt mid-decode, resume through the kernel arm: the rebuilt
+    page table must feed the kernel exactly the tokens the dense arm
+    (and an unpreempted generate()) sees."""
+    _, _, engine = stack
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 64, size=18).astype(np.int32)
+
+    def run(kernel):
+        srv = kernel_server(engine, kernel, num_slots=2)
+        req = srv.submit(prompt, max_new_tokens=12)
+        for _ in range(4):                       # partway through decode
+            srv.step()
+        srv.preempt(req.request_id)
+        assert req.preemptions == 1
+        srv.run_until_drained(max_steps=200)
+        srv.check_invariants()
+        return req
+
+    a, b = run("on"), run("off")
+    assert a.state == RequestState.FINISHED
+    np.testing.assert_array_equal(a.tokens(), b.tokens())
+    expected = engine.generate(np.asarray(prompt)[None],
+                               max_new_tokens=12)[0]
+    np.testing.assert_array_equal(a.tokens(), expected)
+
+
+# ---------------------------------------------------------------------------
+# zero-recompile churn
+
+
+def test_kernel_churn_never_recompiles_after_warmup(stack):
+    """A warm replay of the whole workload (slot churn, prefix hits,
+    every admission grouping it uses) through the kernel server must not
+    grow any executable cache."""
+    _, _, engine = stack
+    prompts, budgets = _mixed_workload(seed=13, n=6)
+    srv = kernel_server(engine, "on")
+    run_traffic(srv, prompts, budgets)
+    srv.end_warmup()
+    run_traffic(srv, prompts, budgets)
+    assert srv.watchdog.recompiles == 0
+    manifest = srv.watchdog.signature_manifest()
+    assert "SlotPool._paged_decode_kernel_jit" in manifest
