@@ -55,6 +55,19 @@ per-token latency, tokens/s ratio, and MFU from the runtime cost model
 the zero-recompile gate (``--max-recompiles 0``) and the
 ``--signatures`` manifest for ``--require-signature-match``.
 
+``python bench.py serving-tp`` runs the multi-chip serving row on the
+forced 8-device CPU host (``--xla_force_host_platform_device_count=8``,
+exported before the row's own jax import): TP=1 (mesh ``data=8``) vs
+TP=2 (``data=4, model=2``) with bitwise-identical greedy outputs across
+mesh shapes (only shardings move; jit signatures do not), plus a DP=2
+``ReplicaRouter`` over two paged replicas on disjoint 4-device meshes
+vs one identically-configured replica, on a 4-session-group workload
+whose prefixes overflow a single page pool. Headline ``vs_baseline`` =
+router req/s over single-replica req/s (session affinity keeps each
+group's prefix resident where the single pool thrashes), gated by
+``check_regression.py --threshold 1.5`` together with
+``--max-recompiles 0 --require-zero-leaks --require-signature-match``.
+
 ``python bench.py serving-async`` runs the async front-end row: the
 stdlib asyncio HTTP/SSE server (deepspeed_tpu/serving/frontend/) on a
 localhost socket with Poisson arrivals at three priority tiers
@@ -1046,6 +1059,336 @@ def paging_main():
     })
 
 
+def serving_tp_main():
+    """Multi-chip serving row: (data, model)-mesh sharded engines plus
+    the data-parallel replica router, on the forced 8-device CPU host.
+
+    Three arm families on one model/workload family:
+
+    * **TP=1** (mesh ``data=8, model=1``) and **TP=2** (``data=4,
+      model=2``): the same stall-free dense-slot serving config on two
+      mesh shapes. Greedy outputs must be BITWISE identical across the
+      two meshes and across replications (the tentpole parity
+      invariant), and neither arm may recompile after warmup (the jit
+      signatures are mesh-shape-independent; only shardings move).
+    * **DP=2 router**: a :class:`ReplicaRouter` over two paged replicas
+      on DISJOINT 4-device meshes vs ONE identically-configured paged
+      replica, on a 4-session-group workload whose prefixes cannot all
+      fit in one replica's page pool. Session affinity keeps each
+      group's prefix resident on its home replica while the single
+      replica thrashes (evicts and re-prefills) — the skipped prefill
+      chunks are the aggregate-throughput win the headline gates
+      (``vs_baseline`` = router req/s over single-replica req/s,
+      ``check_regression.py --threshold 1.5``).
+
+    Example::
+
+        python bench.py serving-tp --json BENCH_serving_tp.json \\
+            --signatures signatures.json
+        python check_regression.py BENCH_serving_tp.json \\
+            BENCH_serving_tp.json --threshold 1.5 --max-recompiles 0 \\
+            --require-zero-leaks --signatures-json signatures.json \\
+            --require-signature-match
+
+    The row also carries the zero-leak / invariant / timeline gates
+    (``--require-zero-leaks``) summed over ALL five servers, and every
+    arm merge-unions its warmup manifest into ``--signatures`` for the
+    ``--require-signature-match`` gate.
+    """
+    import os
+
+    # Both env vars must land BEFORE the first jax import in this
+    # process: XLA_FLAGS is read once at backend initialization
+    # (exporting it later is a silent no-op and every mesh axis comes up
+    # size 1), and JAX_PLATFORMS=cpu must ride along or an accelerator
+    # plugin force-selects itself and the forced cpu devices never
+    # exist. Same interaction tests/conftest.py::tp_mesh documents.
+    _flag = "--xla_force_host_platform_device_count=8"
+    if _flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = \
+            (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+
+    _enable_persistent_cache()
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer_lm import (TransformerConfig,
+                                                     TransformerLM)
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.serving import ReplicaRouter, ServingEngine
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+
+    cfg = TransformerConfig(vocab_size=512, max_seq_len=1024, n_embd=128,
+                            n_layer=4, n_head=4, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32),
+                        method=model.logits)["params"]
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise RuntimeError(
+            f"serving-tp needs the forced 8-device host ({len(devs)} "
+            f"visible) — was jax imported before this row set XLA_FLAGS?")
+
+    def make_engine(devices, data, model_ax):
+        # serving reads the global mesh at CONSTRUCTION time only, so
+        # installing each engine's mesh just before building it (and its
+        # server) is sufficient — replicas on disjoint meshes then step
+        # concurrently without touching the global registry
+        mesh = mesh_mod.build_mesh(devices=devices, data=data,
+                                   model=model_ax)
+        mesh_mod.set_mesh(mesh)
+        return ds.init_inference(model, model_parameters=params,
+                                 dtype="fp32", mesh=mesh)
+
+    gen = np.random.default_rng(0)
+
+    # -- tensor-parallel arms (dense slots, stall-free admission) ------
+    slots_tp, chunk = 8, 256
+    budget_tp = 2 * chunk + 64 * slots_tp
+    n_tp, long_hi = 24, 512
+    tp_prompts, tp_budgets = [], []
+    for i in range(n_tp):
+        T = int(gen.integers(300, 500)) if i % 6 == 5 \
+            else int(gen.integers(17, 33))
+        tp_prompts.append(gen.integers(0, cfg.vocab_size, size=T)
+                          .astype(np.int32))
+        tp_budgets.append(int(gen.integers(8, 17)))
+
+    def make_tp(data, model_ax):
+        eng = make_engine(devs, data, model_ax)
+        return ServingEngine(eng, num_slots=slots_tp,
+                             max_queue_depth=2 * n_tp,
+                             prefill_chunk=chunk,
+                             prefill_token_budget=budget_tp,
+                             strict_recompile=True)
+
+    def warm_tp(srv):
+        # stall-row discipline: every admission grouping the static
+        # checker enumerates — singleton width buckets up to the chunk,
+        # each (rows x width) group the token budget allows, one
+        # chunk-looped long prefill — then arm the watchdog
+        w = 16
+        while w <= chunk:
+            for count in range(1, min(slots_tp,
+                                      max(1, budget_tp // w)) + 1):
+                for _ in range(count):
+                    srv.submit(np.ones((w,), np.int32), max_new_tokens=2)
+                srv.run_until_drained()
+            w *= 2
+        srv.submit(np.ones((long_hi,), np.int32), max_new_tokens=2)
+        srv.run_until_drained()
+        srv.end_warmup()
+
+    def run_tp(srv):
+        # fresh aggregates per replication; warmup and earlier reps
+        # polluted the percentile digests
+        srv.metrics = ServingMetrics(None, registry=srv.registry,
+                                     step_fn=lambda s=srv: s.step_id)
+        t0 = time.perf_counter()
+        reqs = [srv.submit(p, max_new_tokens=b)
+                for p, b in zip(tp_prompts, tp_budgets)]
+        srv.run_until_drained(max_steps=50_000)
+        wall = time.perf_counter() - t0
+        s = srv.stats()
+        s["wall_s"] = wall
+        s["outputs"] = [list(r.output_tokens) for r in reqs]
+        return s
+
+    tp1 = make_tp(data=8, model_ax=1)
+    warm_tp(tp1)
+    tp2 = make_tp(data=4, model_ax=2)
+    warm_tp(tp2)
+
+    # -- data-parallel router arms (paged KV, session affinity) --------
+    # geometry chosen so ONE replica's page pool cannot hold all four
+    # session groups' prefixes (4 x 8 pages + working set > 24 pages)
+    # while each router replica CAN hold its own two (2 x 8 + working
+    # set < 24): the single replica thrashes, the router does not
+    ps, prefix_pages, n_groups = 32, 8, 4
+    prefix_len = prefix_pages * ps
+    slots_dp, num_pages, n_dp, gen_dp = 2, 24, 32, 8
+    budget_dp = 2 * ps + 16 * slots_dp
+    prefixes = {g: gen.integers(1, cfg.vocab_size, size=prefix_len)
+                .astype(np.int32) for g in range(n_groups)}
+    dp_reqs = []
+    for i in range(n_dp):
+        g = i % n_groups   # strict group cycling: the LRU-worst order
+        suf = gen.integers(1, cfg.vocab_size,
+                           size=int(gen.integers(4, 12))).astype(np.int32)
+        dp_reqs.append((str(g), np.concatenate([prefixes[g], suf])))
+
+    def make_dp(devices):
+        eng = make_engine(devices, data=len(devices), model_ax=1)
+        return ServingEngine(eng, num_slots=slots_dp,
+                             max_queue_depth=2 * n_dp,
+                             prefill_chunk=ps,
+                             prefill_token_budget=budget_dp,
+                             strict_recompile=True,
+                             paged_kv={"page_size": ps,
+                                       "num_pages": num_pages})
+
+    def warm_dp(srv):
+        # same sweep as the paging row: distinct leading tokens keep
+        # the warm prompts from prefix-hitting themselves
+        tok = 0
+
+        def warm(w, count):
+            nonlocal tok
+            for _ in range(count):
+                tok += 1
+                srv.submit(np.full((w,), tok, np.int32), max_new_tokens=2)
+            srv.run_until_drained()
+
+        w = 16
+        while w <= ps:
+            for count in range(1, min(slots_dp,
+                                      max(1, budget_dp // w)) + 1):
+                warm(w, count)
+            w *= 2
+        warm(prefix_len + 16, 1)   # chunk-loop long prefill
+        # page-aligned exact duplicate: the full-page hit + decode
+        # forces the copy-on-write page copy, the one paged program the
+        # distinct-token sweep above can never reach
+        dup = np.full((2 * ps,), cfg.vocab_size - 3, np.int32)
+        for _ in range(2):
+            srv.submit(dup, max_new_tokens=2)
+            srv.run_until_drained()
+        srv.end_warmup()
+
+    single = make_dp(devs[:4])
+    warm_dp(single)
+    rep_a = make_dp(devs[:4])
+    warm_dp(rep_a)
+    rep_b = make_dp(devs[4:])
+    warm_dp(rep_b)
+    router = ReplicaRouter([rep_a, rep_b])
+
+    if _SIGNATURES_PATH:
+        extra_tp = {"vocab_size": cfg.vocab_size, "max_prompt_len": long_hi}
+        extra_dp = {"vocab_size": cfg.vocab_size,
+                    "max_seed_len": prefix_len + 16 + gen_dp}
+        tp1.export_signatures(_SIGNATURES_PATH, merge=True, extra=extra_tp)
+        tp2.export_signatures(_SIGNATURES_PATH, merge=True, extra=extra_tp)
+        for srv in (single, rep_a, rep_b):
+            srv.export_signatures(_SIGNATURES_PATH, merge=True,
+                                  extra=extra_dp)
+
+    def run_dp(target, use_session):
+        t0 = time.perf_counter()
+        reqs = []
+        for sess, prompt in dp_reqs:
+            kw = {"session": sess} if use_session else {}
+            reqs.append(target.submit(prompt, max_new_tokens=gen_dp, **kw))
+        target.run_until_drained(max_steps=100_000)
+        wall = time.perf_counter() - t0
+        return {"requests_per_s": n_dp / wall,
+                "outputs": [list(r.output_tokens) for r in reqs]}
+
+    # interleaved replications with per-metric medians (single-CPU
+    # replays jitter enough to flip a close verdict); every arm is
+    # fully warmed, so the strict watchdogs police the whole timed
+    # phase — any recompile here raises at the step boundary
+    reps = 3
+    tp1_runs, tp2_runs, single_runs, router_runs = [], [], [], []
+    for _ in range(reps):
+        tp1_runs.append(run_tp(tp1))
+        tp2_runs.append(run_tp(tp2))
+        single_runs.append(run_dp(single, use_session=False))
+        router_runs.append(run_dp(router, use_session=True))
+
+    def _med(runs, key):
+        return float(np.median([r[key] for r in runs]))
+
+    tp_parity = all(r["outputs"] == tp1_runs[0]["outputs"]
+                    for r in tp1_runs + tp2_runs)
+    dp_parity = all(r["outputs"] == single_runs[0]["outputs"]
+                    for r in single_runs + router_runs)
+    single_rps = _med(single_runs, "requests_per_s")
+    router_rps = _med(router_runs, "requests_per_s")
+    dp_ratio = router_rps / max(single_rps, 1e-9)
+
+    servers = [tp1, tp2, single, rep_a, rep_b]
+    recompiles = (tp1.watchdog.recompiles + tp2.watchdog.recompiles
+                  + single.watchdog.recompiles + router.recompiles)
+    leaks = sum(s.pool.num_slots - s.pool.free_count - s.live_count
+                for s in servers)
+    invariants_ok = True
+    try:
+        for s in servers[:3]:
+            s.check_invariants()
+        router.check_invariants()
+    except Exception:
+        invariants_ok = False
+    open_tl = [rid for s in servers for rid in s.timelines.open_ids()]
+    timelines_complete = not open_tl
+
+    sstats = single.stats()["paging"]
+    astats = rep_a.stats()["paging"]
+    bstats = rep_b.stats()["paging"]
+    rstats = router.stats()
+
+    def tp_detail(runs, srv):
+        s = runs[-1]
+        return {"requests_per_s": round(_med(runs, "requests_per_s"), 3),
+                "per_token_p50_ms": round(_med(runs, "per_token_p50_ms"),
+                                          2),
+                "per_token_p99_ms": round(_med(runs, "per_token_p99_ms"),
+                                          2),
+                "step_gap_p99_ms": round(_med(runs, "step_gap_p99_ms"), 2),
+                "completed": s["completed"],
+                "mesh": {"data": srv._mesh_axis_size("data"),
+                         "model": srv._mesh_axis_size("model")}}
+
+    _emit({
+        "metric": f"multi-chip serving ((data,model) mesh + DP router, "
+                  f"forced 8-device host; DP: {n_groups} session groups "
+                  f"x {prefix_pages}-page prefixes over {num_pages}-page "
+                  f"pools): router req/s over single replica",
+        "value": round(dp_ratio, 3),
+        "unit": "aggregate req/s ratio (higher is better)",
+        "vs_baseline": round(dp_ratio, 3),
+        "detail": {
+            "baseline": "ONE paged replica with the identical serving "
+                        "config and page pool, same workload without "
+                        "session routing — its pool cannot hold every "
+                        "group's prefix, so admissions thrash the trie "
+                        "(evict + re-prefill) where the router's "
+                        "session affinity keeps each group's prefix "
+                        "resident on its home replica",
+            "greedy_parity_tp": bool(tp_parity),
+            "greedy_parity_dp": bool(dp_parity),
+            "recompiles_after_warmup": int(recompiles),
+            "slot_leaks": int(leaks),
+            "invariants_ok": bool(invariants_ok),
+            "timelines_complete": bool(timelines_complete),
+            "replications": reps,
+            "tp1": tp_detail(tp1_runs, tp1),
+            "tp2": tp_detail(tp2_runs, tp2),
+            "dp": {
+                "single_requests_per_s": round(single_rps, 3),
+                "router_requests_per_s": round(router_rps, 3),
+                "single_page_evictions": sstats["page_evictions"],
+                "single_prefix_hits": sstats["prefix_hits"],
+                "single_prefix_misses": sstats["prefix_misses"],
+                "replica_page_evictions": [astats["page_evictions"],
+                                           bstats["page_evictions"]],
+                "replica_prefix_hits": [astats["prefix_hits"],
+                                        bstats["prefix_hits"]],
+                "replica_prefix_misses": [astats["prefix_misses"],
+                                          bstats["prefix_misses"]],
+                "router": {"dispatched": rstats["dispatched"],
+                           "affinity_hits": rstats["affinity_hits"],
+                           "spills": rstats["spills"],
+                           "failovers": rstats["failovers"]},
+            },
+        },
+    })
+
+
 def serving_decode_main():
     """Raw-decode-speed row: the fused paged-attention decode kernel plus
     overlapped host scheduling (``paged_kv={"kernel": "on"}, overlap=True``)
@@ -1802,6 +2145,8 @@ if __name__ == "__main__":
         entry = serving_chaos_main
     elif "serving-async" in argv:
         entry = serving_async_main
+    elif "serving-tp" in argv:
+        entry = serving_tp_main
     elif "paging" in argv:
         entry = paging_main
     elif "serving-decode" in argv:

@@ -1494,6 +1494,12 @@ def default_check_envs() -> List[dict]:
         # config, decode/verify dispatch through the Pallas kernel jits
         dict(paging, stall_free=True, paged_kernel="on",
              paged_kernel_active=True),
+        # the serving-tp bench row's sharded arm: a (data, model) mesh
+        # changes ONLY array placements, never a traced shape, so its
+        # enumerated signature set must be identical to the dense env's
+        # (mesh_data/mesh_model ride along in _signature_env for config
+        # identity; the drivers ignore unknown keys)
+        dict(stall, stall_free=True, mesh_data=4, mesh_model=2),
     ]
 
 

@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .. import comm as dist
 from ..module_inject import replace_module
 from ..parallel import mesh as mesh_mod
+from ..parallel.axis_rules import physical_spec
 from ..runtime.zero.policy import ShardingRules, _path_str
 from ..utils.logging import log_dist
 from .config import DeepSpeedInferenceConfig
@@ -138,6 +139,12 @@ class InferenceEngine:
             spec = self._rules.spec_for(_path_str(path))
             if spec is None or len(spec) != np.ndim(leaf):
                 spec = PartitionSpec(*([None] * np.ndim(leaf)))
+            # canonicalize through the axis-rules guard: size-1 mesh axes
+            # and axes that don't divide the dim collapse to replicated,
+            # and trailing Nones are stripped so equivalent placements
+            # produce IDENTICAL NamedShardings (P() vs P(None,'model') on
+            # a 1-wide axis would otherwise fork jit executables)
+            spec = physical_spec(tuple(spec), np.shape(leaf), self.mesh)
             return NamedSharding(self.mesh, spec)
 
         if self._use_int8_compute():

@@ -41,7 +41,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..parallel.mesh import MODEL_AXIS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,24 +135,40 @@ def transformer_config(family: str, **overrides) -> TransformerConfig:
     return TransformerConfig(**{**FAMILY_PRESETS[family], **overrides})
 
 
-def transformer_sharding_rules():
+def transformer_logical_axes():
+    """LOGICAL axis annotations for this module's parameter paths (≅ t5x
+    ``param_with_axes`` metadata, expressed as path patterns so the flax
+    modules stay annotation-free). Works for every family preset (paths
+    are family-invariant). Scanned blocks carry a leading ``layers`` dim;
+    ``heads`` is the fused heads*head_dim projection width and ``ffn``
+    the MLP hidden width."""
+    return [
+        (r"embed_tokens/embedding", ("vocab", "embed")),
+        (r"embed_pos/embedding", ("positions", "embed")),
+        (r"attn/(q_proj|k_proj|v_proj)/kernel", ("layers", "embed", "heads")),
+        (r"attn/o_proj/kernel", ("layers", "heads", "embed")),
+        (r"attn/(q_proj|k_proj|v_proj)/bias", ("layers", "heads")),
+        (r"mlp/(up_proj|gate_proj)/kernel", ("layers", "embed", "ffn")),
+        (r"mlp/(up_proj|gate_proj)/bias", ("layers", "ffn")),
+        (r"mlp/down_proj/kernel", ("layers", "ffn", "embed")),
+        (r"lm_head/kernel", ("embed", "vocab")),
+    ]
+
+
+def transformer_sharding_rules(rules=None):
     """Megatron-style TP rules for this module's parameter paths — the
     AutoTP analog (reference module_inject/auto_tp.py:13): column-parallel
-    up-projections, row-parallel down-projections, vocab-parallel embedding.
-    Works for every family preset (paths are family-invariant). Scanned
-    blocks carry a leading layer dim."""
-    M = MODEL_AXIS
-    return [
-        (r"embed_tokens/embedding", (M, None)),
-        (r"embed_pos/embedding", (None, None)),
-        (r"attn/(q_proj|k_proj|v_proj)/kernel", (None, None, M)),
-        (r"attn/o_proj/kernel", (None, M, None)),
-        (r"attn/(q_proj|k_proj|v_proj)/bias", (None, M)),
-        (r"mlp/(up_proj|gate_proj)/kernel", (None, None, M)),
-        (r"mlp/(up_proj|gate_proj)/bias", (None, M)),
-        (r"mlp/down_proj/kernel", (None, M, None)),
-        (r"lm_head/kernel", (None, M)),
-    ]
+    up-projections, row-parallel down-projections, vocab-parallel
+    embedding. Derived by resolving :func:`transformer_logical_axes`
+    through the ``parallel/`` axis-rules table (``rules`` overrides the
+    default) so one table swap re-partitions the module; the default
+    table reproduces the historical hard-coded placement exactly
+    (pinned by tests/unit/parallel/test_axis_rules.py)."""
+    from ..parallel.axis_rules import default_axis_rules
+
+    rules = rules if rules is not None else default_axis_rules()
+    return [(pat, rules.spec_entries(axes))
+            for pat, axes in transformer_logical_axes()]
 
 
 def _dense(cfg: TransformerConfig, features: int, *, use_bias: bool,

@@ -26,6 +26,8 @@ from .request import (FinishReason, RejectReason, Request,  # noqa: F401
 from .resilience import (DegradationConfig, FaultInjector,  # noqa: F401
                          InjectedFault, InvariantViolation, LoadState,
                          ServingStalledError)
+from .router import (ID_STRIDE, NoLiveReplicaError,  # noqa: F401
+                     ReplicaRouter)
 from .scheduler import FIFOScheduler  # noqa: F401
 from .slot_pool import SlotPool  # noqa: F401
 from .spec_decode import (  # noqa: F401
@@ -40,6 +42,7 @@ __all__ = ["ServingEngine", "ServingMetrics", "Request", "RequestState",
            "SpecDecodeConfig", "Drafter", "NGramDrafter",
            "SmallModelDrafter", "DegradationConfig", "FaultInjector",
            "InjectedFault", "InvariantViolation", "LoadState",
-           "ServingStalledError", "AsyncEngineBridge", "TokenStream",
+           "ServingStalledError", "ReplicaRouter", "NoLiveReplicaError",
+           "ID_STRIDE", "AsyncEngineBridge", "TokenStream",
            "PriorityScheduler", "PriorityConfig", "TenantPolicy",
            "ServingFrontend"]

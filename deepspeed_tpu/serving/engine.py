@@ -180,13 +180,23 @@ class ServingEngine:
                 "serving requires the module to expose prefill_last("
                 "input_ids, last_pos) for bucketed slot prefill")
         cfg = engine._config
-        # pin the pool to the engine's replicated sharding so the cold
-        # cache matches the committed arrays its jitted steps hand back
-        # (otherwise the first admission compiles a second executable)
+        # pin the pool to the axis-rules placement for the engine's mesh
+        # so the cold cache matches the committed arrays its jitted
+        # steps hand back (otherwise the first admission compiles a
+        # second executable). The per-leaf resolver shards k/v over
+        # (data, model) where the mesh and shapes allow it and resolves
+        # to the historical replicated placement everywhere else — on a
+        # TP=1/DP=1 mesh every leaf is replicated, which is how the
+        # single-chip path stays the bitwise oracle.
         rep = None
         if getattr(engine, "mesh", None) is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            rep = NamedSharding(engine.mesh, PartitionSpec())
+            from ..parallel.axis_rules import cache_leaf_sharding
+            rep = cache_leaf_sharding(
+                "paged" if paged_kv else "stacked", mesh=engine.mesh)
+        # kept for the current-token twin: every host-built slots-shaped
+        # array is committed through the same resolver (key "index") so
+        # its placement always matches the pool's per-slot index leaf
+        self._pool_sharding = rep
         # -- paged KV (ISSUE 7): page-pooled storage + prefix cache ----
         # paged_kv: False (contiguous rows), True (paged, defaults), or a
         # dict {"num_pages": int, "page_size": int, "prefix_cache": bool}
@@ -402,17 +412,29 @@ class ServingEngine:
         # single-device first arg would give _jit_cur_scatter a second
         # cache entry for the same shapes, a recompile the watchdog
         # rightly flags.
+        # canonical placement for the twin: the pool's resolved ``index``
+        # sharding (slots over `data` when the mesh and count allow,
+        # replicated otherwise — so TP=1/DP=1 keeps today's placement
+        # bitwise). EVERY producer of _cur_dev is pinned to it; GSPMD is
+        # otherwise free to hand back the sampler's batch-sharded layout
+        # and fork _jit_cur_scatter the first time an admission lands
+        # after a decode (warmup can't sweep that ordering).
+        self._cur_sharding = (
+            self._pool_sharding("index", np.zeros((num_slots,), np.int32))
+            if callable(self._pool_sharding) else self._rep_sharding())
         self._cur_dev = jax.device_put(
-            np.zeros((num_slots,), np.int32), self._rep_sharding())
+            np.zeros((num_slots,), np.int32), self._cur_sharding)
         self._jit_cur_scatter = jax.jit(
-            lambda cur, tok, slots: cur.at[slots].set(tok, mode="drop"))
+            lambda cur, tok, slots: cur.at[slots].set(tok, mode="drop"),
+            out_shardings=self._cur_sharding)
         # after a verify step the new current token for row b is the last
         # *emitted* token: out[b, n_emit[b]-1] (n_emit >= 1 for live rows;
         # the max() guards masked rows, whose value is never surfaced)
         self._jit_spec_cur = jax.jit(
             lambda out, n_emit: jnp.take_along_axis(
                 out, jnp.maximum(n_emit - 1, 0)[:, None],
-                axis=1)[:, 0].astype(jnp.int32))
+                axis=1)[:, 0].astype(jnp.int32),
+            out_shardings=self._cur_sharding)
         self._overlap = bool(overlap)
         # pre-warm every reachable cur-scatter width NOW, before the
         # watchdog attaches below: singles scatter (1,) and batched
@@ -425,7 +447,7 @@ class ServingEngine:
         while True:
             self._jit_cur_scatter(
                 self._cur_dev,
-                jax.device_put(np.zeros((nb,), np.int32), rep),
+                self._cur_commit(np.zeros((nb,), np.int32)),
                 jnp.asarray(np.full((nb,), num_slots, np.int32)))
             if nb >= num_slots:
                 break
@@ -503,7 +525,21 @@ class ServingEngine:
             "use_prefix": bool(self._use_prefix),
             "stall_free": bool(self._stall_free),
             "overlap": bool(self._overlap),
+            # mesh shape the caches/params were committed under. The
+            # jitted entries keep their signatures across mesh shapes
+            # (the tentpole invariant — only in/out shardings move), so
+            # the interp drivers ignore these keys; they are recorded so
+            # a manifest diff can attribute a mismatch to the arm that
+            # produced it.
+            "mesh_data": int(self._mesh_axis_size("data")),
+            "mesh_model": int(self._mesh_axis_size("model")),
         }
+
+    def _mesh_axis_size(self, axis: str) -> int:
+        mesh = getattr(self.engine, "mesh", None)
+        if mesh is None:
+            return 1
+        return int(dict(mesh.shape).get(axis, 1))
 
     def export_signatures(self, path: str, merge: bool = False,
                           extra: Optional[dict] = None) -> dict:
@@ -858,6 +894,19 @@ class ServingEngine:
         from ..parallel import mesh as mesh_mod
         return NamedSharding(mesh_mod.get_mesh(), PartitionSpec())
 
+    def _cur_commit(self, arr):
+        """Commit a current-token-family array (any width) to the same
+        resolved slots placement the pool's ``index`` leaf carries —
+        shape-aware, so a (1,) single-admission token stays replicated
+        while a full-width batch shards with the pool. Pinning every
+        producer keeps ``_jit_cur_scatter`` at one executable per width
+        no matter what layout GSPMD picked for the sampler output."""
+        if callable(self._pool_sharding):
+            sh = self._pool_sharding("index", np.asarray(arr))
+        else:
+            sh = self._rep_sharding()
+        return jax.device_put(arr, sh)
+
     def _sample_dev(self, logits):
         """Dispatch the sampler and return the token *device* array.
 
@@ -930,7 +979,7 @@ class ServingEngine:
                 with self.tracer.span("serving/sample"):
                     # dispatch only; the host value arrives at the
                     # end-of-step fetch
-                    tok_dev = self._sample_dev(logits)
+                    tok_dev = self._cur_commit(self._sample_dev(logits))
                 self._cur_dev = self._jit_cur_scatter(
                     self._cur_dev, tok_dev, jnp.asarray([slot]))
             now = self._now()
@@ -1187,7 +1236,7 @@ class ServingEngine:
                 with self.tracer.span("serving/sample"):
                     # dispatch only; host values arrive at the
                     # end-of-step fetch
-                    tokens_dev = self._sample_dev(logits)
+                    tokens_dev = self._cur_commit(self._sample_dev(logits))
                 self._cur_dev = self._jit_cur_scatter(
                     self._cur_dev, tokens_dev, jnp.asarray(slots))
             now = self._now()
@@ -1277,7 +1326,7 @@ class ServingEngine:
             with self.tracer.span("serving/sample"):
                 # dispatch only; host value arrives at the end-of-step
                 # fetch
-                tok_dev = self._sample_dev(logits)
+                tok_dev = self._cur_commit(self._sample_dev(logits))
             self._cur_dev = self._jit_cur_scatter(
                 self._cur_dev, tok_dev, jnp.asarray([slot]))
             self.metrics.record_prefill(L, self._now() - t0,
@@ -1770,8 +1819,9 @@ class ServingEngine:
         # full-batch overwrite: every row's next current token IS this
         # decode's sample for that row (non-running rows hold garbage a
         # masked decode row can never surface, and any later admission
-        # scatter overwrites them)
-        self._cur_dev = nxt_dev
+        # scatter overwrites them); re-committed to the canonical slots
+        # placement — a free transfer when GSPMD already chose it
+        self._cur_dev = self._cur_commit(nxt_dev)
 
         def _on_decode(nxt, finite=None, running=running):
             live = self._guard_rows(finite, running)
@@ -1944,7 +1994,7 @@ class ServingEngine:
         self._deferred.clear()
         self._cur_dev = jax.device_put(
             np.zeros((self.pool.num_slots,), np.int32),
-            self._rep_sharding())
+            self._cur_sharding)
         self.pool.reset()
 
     def run_until_drained(self, max_steps: Optional[int] = None,

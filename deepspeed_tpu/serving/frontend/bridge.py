@@ -187,6 +187,11 @@ class AsyncEngineBridge:
 
     def _has_work(self) -> bool:
         srv = self.srv
+        # duck-typed: a ReplicaRouter exposes has_work() (aggregated over
+        # alive replicas); a bare engine is probed through its internals
+        probe = getattr(srv, "has_work", None)
+        if callable(probe):
+            return bool(probe())
         return bool(srv.live_count or srv.scheduler.pending
                     or getattr(srv, "_prefill_queue", None))
 

@@ -153,15 +153,14 @@ class PagedKVPool(SlotPool):
         cs["index"] = jnp.zeros((self.num_slots,), jnp.int32)
         cs["table"] = jnp.full((self.num_slots, self.pages_per_slot),
                                self.num_pages, jnp.int32)
-        cache = {"cache_store": cs}
         if self._sharding is not None:
-            cache = jax.device_put(cache, self._sharding)
-        return cache
+            cs = {k: self._place_leaf(k, v) for k, v in cs.items()}
+        return {"cache_store": cs}
 
     def _table_from_mirror(self):
         tbl = jnp.array(self.table, copy=True)
         if self._sharding is not None:
-            tbl = jax.device_put(tbl, self._sharding)
+            tbl = self._place_leaf("table", tbl)
         return tbl
 
     def _sync_table(self) -> None:

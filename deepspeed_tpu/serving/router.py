@@ -1,0 +1,320 @@
+"""Data-parallel replica router: one front door over N serving engines.
+
+Tensor parallelism (the ``model`` mesh axis) shrinks per-token latency;
+data parallelism over REPLICAS grows aggregate throughput. The router is
+the host half of that trade: it fronts N independent
+:class:`~deepspeed_tpu.serving.engine.ServingEngine` replicas — each
+with its own slot pool, scheduler and compiled programs — behind a
+single ``submit``/``step``/``cancel`` surface shaped exactly like one
+engine, so the async front end (:mod:`.frontend.bridge`) drives a
+router or a bare engine interchangeably.
+
+Dispatch policy, in priority order:
+
+1. **Session stickiness** — ``submit(..., session=key)`` pins every
+   request of a conversation to the replica that served it last, so its
+   paged prefix cache keeps compounding across turns.
+2. **Prefix affinity** — with paged KV, each replica's
+   :class:`~deepspeed_tpu.serving.prefix_cache.PrefixCache` trie is
+   ``peek``-scored against the prompt (a pure read: no LRU mutation)
+   and the longest full-page hit wins. A cached prefix is worth more
+   than an idle replica: skipped prefill chunks beat queue position.
+3. **Least loaded** — fewest ``live + pending`` requests.
+4. **Lowest replica index** — the deterministic tie-break; two routers
+   fed the same request sequence dispatch identically (pinned by test).
+
+Admission spill: when the chosen replica REJECTS (queue full, page
+footprint), the router retries the remaining replicas in the same
+ranked order before surfacing the rejection — N bounded queues behave
+like one shared admission queue until every one of them is full.
+
+Failure containment: a replica whose ``step()`` raises is marked dead
+and never stepped again. Every request it still owed — queued, seated
+mid-prefill, decoding, or FAILED by the engine's own mid-step abort —
+is scrubbed back to QUEUED (``Request.seed_tokens`` carries prompt +
+generated-so-far, so greedy resume is bitwise identical to never having
+failed) and re-submitted to a surviving sibling. Slots and pages of the
+dead replica die with it; siblings' invariants stay clean.
+
+Request ids stay globally unique across replicas: replica ``i``'s
+engine counter is offset to ``i * ID_STRIDE`` at construction, so a
+router-issued id names one request no matter which replica seated it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import ServingEngine
+from .request import FinishReason, Request, RequestState
+
+# id-space stride per replica: replica i issues ids in
+# [i*ID_STRIDE, (i+1)*ID_STRIDE) — collision would need a billion
+# requests through one replica in one process lifetime
+ID_STRIDE = 1_000_000_000
+
+
+class NoLiveReplicaError(RuntimeError):
+    """Every replica has failed; the router can no longer make progress."""
+
+
+class ReplicaRouter:
+    """Route requests across data-parallel :class:`ServingEngine` replicas.
+
+    ``replicas`` must be non-empty; each should be built on its own
+    :class:`~deepspeed_tpu.inference.engine.InferenceEngine` (they may
+    share a mesh — DP over replicas is a host-side construct; the mesh
+    ``data`` axis shards slots WITHIN a replica). ``affinity=False``
+    disables prefix-trie scoring (dispatch is then sticky-session →
+    least-loaded only).
+    """
+
+    def __init__(self, replicas: Sequence[ServingEngine],
+                 affinity: bool = True):
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        self.replicas: List[ServingEngine] = list(replicas)
+        self.affinity = bool(affinity)
+        self._alive: List[bool] = [True] * len(self.replicas)
+        for i, rep in enumerate(self.replicas):
+            # offset, don't overwrite: a replica with prior traffic keeps
+            # its issued ids unique within its own stripe
+            rep._next_id += i * ID_STRIDE
+        self._owner: Dict[int, int] = {}       # request_id -> replica idx
+        self._session: Dict[str, int] = {}     # session key -> replica idx
+        self._tracked: Dict[int, Request] = {}  # live (non-terminal) reqs
+        self.dispatched = [0] * len(self.replicas)
+        self.affinity_hits = 0
+        self.spills = 0          # admissions that fell through to a sibling
+        self.failovers = 0       # requests re-homed off a dead replica
+
+    # -- introspection -------------------------------------------------
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def alive_replicas(self) -> List[int]:
+        return [i for i, a in enumerate(self._alive) if a]
+
+    @property
+    def live_count(self) -> int:
+        return sum(r.live_count for i, r in enumerate(self.replicas)
+                   if self._alive[i])
+
+    @property
+    def pending(self) -> int:
+        return sum(r.scheduler.pending for i, r in enumerate(self.replicas)
+                   if self._alive[i])
+
+    def has_work(self) -> bool:
+        """Any alive replica holding queued, prefilling or running work —
+        the bridge's step-gate probe (duck-typed: it prefers a callable
+        ``has_work`` over reading engine internals)."""
+        return any(
+            r.live_count or r.scheduler.pending
+            or getattr(r, "_prefill_queue", None)
+            for i, r in enumerate(self.replicas) if self._alive[i])
+
+    def _now(self) -> float:
+        return self.replicas[0]._now()
+
+    # -- dispatch ------------------------------------------------------
+    def _load(self, i: int) -> int:
+        r = self.replicas[i]
+        return r.live_count + r.scheduler.pending
+
+    def _rank(self, prompt, session: Optional[str]) -> List[int]:
+        """Replica indices in dispatch-preference order (alive only)."""
+        alive = self.alive_replicas
+        if not alive:
+            raise NoLiveReplicaError("all replicas have failed")
+        if session is not None:
+            home = self._session.get(session)
+            if home is not None and self._alive[home]:
+                self.affinity_hits += 1
+                return [home] + [i for i in alive if i != home]
+        scores = {i: 0 for i in alive}
+        if self.affinity:
+            for i in alive:
+                trie = getattr(self.replicas[i].pool, "prefix", None)
+                if trie is not None:
+                    scores[i] = int(trie.peek(prompt))
+        # sort: longest prefix hit, then least loaded, then lowest index
+        ranked = sorted(alive, key=lambda i: (-scores[i], self._load(i), i))
+        if scores[ranked[0]] > 0:
+            self.affinity_hits += 1
+        return ranked
+
+    def submit(self, prompt, session: Optional[str] = None,
+               **kwargs: Any) -> Request:
+        """Route one request. Same contract as ``ServingEngine.submit``
+        (never raises on load; REJECTED carries a reason), plus
+        ``session=`` stickiness. A rejection by the preferred replica
+        spills to the next-ranked sibling; the LAST rejection is
+        returned only when every alive replica refused."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ranked = self._rank(prompt, session)
+        req: Optional[Request] = None
+        for n, i in enumerate(ranked):
+            req = self.replicas[i].submit(prompt, **kwargs)
+            if req.state is not RequestState.REJECTED:
+                if n > 0:
+                    self.spills += 1
+                self.dispatched[i] += 1
+                self._owner[req.request_id] = i
+                self._tracked[req.request_id] = req
+                if session is not None:
+                    self._session[session] = i
+                return req
+        return req  # every replica rejected: surface the last verdict
+
+    # -- stepping ------------------------------------------------------
+    def step(self) -> List[Request]:
+        """One iteration of every alive replica. A replica that raises is
+        retired and its requests fail over to the ranked siblings; the
+        error is contained, not propagated (mirrors a multi-host serving
+        tier losing one worker). Raises :class:`NoLiveReplicaError` only
+        when no replica survives to inherit the work."""
+        finished: List[Request] = []
+        for i, rep in enumerate(self.replicas):
+            if not self._alive[i]:
+                continue
+            try:
+                finished.extend(rep.step())
+            except Exception:
+                self._alive[i] = False
+                self._fail_over(i)
+        for req in finished:
+            self._tracked.pop(req.request_id, None)
+        if not any(self._alive):
+            raise NoLiveReplicaError("all replicas have failed")
+        return finished
+
+    def _fail_over(self, dead: int) -> None:
+        """Re-home every request the dead replica still owed.
+
+        The engine's own ``_abort_step`` has already rolled its state to
+        one of three shapes — QUEUED in its scheduler, seated in
+        ``_slot_req`` (when the failure bypassed the abort path), or
+        FAILED with reason ``error`` — and ``check_invariants`` on the
+        corpse is meaningless. The router scrubs each survivor back to a
+        fresh QUEUED request (keeping ``output_tokens``: they are the
+        resume seed) and re-submits through a sibling's admission
+        control, so capacity limits still hold under failover."""
+        rep = self.replicas[dead]
+        owed: List[Request] = []
+        seen: set = set()
+
+        def _take(req: Request) -> None:
+            if id(req) in seen:
+                return
+            seen.add(id(req))
+            owed.append(req)
+
+        for r in list(rep.scheduler.queue):
+            _take(r)
+        rep.scheduler.queue.clear()
+        for r in list(rep._slot_req.values()):
+            _take(r)
+        rep._slot_req.clear()
+        rep._prefill_queue[:] = []
+        # FAILED-by-abort requests the router still tracks: the engine
+        # already charged the failure, but the CLIENT contract is that a
+        # replica loss is invisible — resurrect and re-home them too
+        for rid, r in list(self._tracked.items()):
+            if self._owner.get(rid) == dead \
+                    and r.state is RequestState.FAILED \
+                    and r.finish_reason is FinishReason.ERROR:
+                _take(r)
+        owed.sort(key=lambda r: r.request_id)  # oldest first, deterministic
+        for r in owed:
+            if r.state in (RequestState.FINISHED, RequestState.REJECTED):
+                continue
+            r.state = RequestState.QUEUED
+            r.slot = None
+            r.prefill_pos = 0
+            r.admit_time = None
+            r.finish_reason = None
+            r.finish_time = None
+            r.preemptions += 1
+            placed = False
+            for i in self._rank(r.seed_tokens, None):
+                accepted, _ = self.replicas[i].scheduler.submit(r)
+                if accepted:
+                    self._owner[r.request_id] = i
+                    self._tracked[r.request_id] = r
+                    self.failovers += 1
+                    placed = True
+                    break
+            if not placed:
+                r.state = RequestState.FAILED
+                r.finish_reason = FinishReason.ERROR
+                r.finish_time = self._now()
+                self._tracked.pop(r.request_id, None)
+        # sticky sessions homed on the corpse re-route on next submit
+        for key, idx in list(self._session.items()):
+            if idx == dead:
+                del self._session[key]
+
+    def run_until_drained(self, max_steps: Optional[int] = None,
+                          stall_patience: Optional[int] = None
+                          ) -> List[Request]:
+        """Step until no alive replica has work (mirror of the engine
+        method; ``stall_patience`` is accepted for signature parity but
+        stall detection lives in each replica)."""
+        del stall_patience
+        out: List[Request] = []
+        steps = 0
+        while self.has_work():
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    # -- per-request / lifecycle ---------------------------------------
+    def cancel(self, request_id: int) -> Optional[Request]:
+        idx = self._owner.get(request_id)
+        if idx is None or not self._alive[idx]:
+            return None
+        req = self.replicas[idx].cancel(request_id)
+        if req is not None:
+            self._tracked.pop(request_id, None)
+        return req
+
+    def end_warmup(self) -> None:
+        for i in self.alive_replicas:
+            self.replicas[i].end_warmup()
+
+    def check_invariants(self) -> None:
+        """Cross-replica audit: every ALIVE replica's slot/queue/pool
+        bookkeeping must hold (dead replicas are tombstones — their
+        state was deliberately stripped by failover)."""
+        for i in self.alive_replicas:
+            self.replicas[i].check_invariants()
+
+    @property
+    def recompiles(self) -> int:
+        """Post-warmup recompiles summed over alive replicas' watchdogs."""
+        total = 0
+        for i in self.alive_replicas:
+            wd = self.replicas[i].watchdog
+            if wd is not None:
+                total += wd.recompiles
+        return total
+
+    def stats(self) -> dict:
+        """Router-level counters plus each alive replica's SLO snapshot."""
+        return {
+            "replicas": self.num_replicas,
+            "alive": self.alive_replicas,
+            "dispatched": list(self.dispatched),
+            "affinity_hits": self.affinity_hits,
+            "spills": self.spills,
+            "failovers": self.failovers,
+            "per_replica": {i: self.replicas[i].stats()
+                            for i in self.alive_replicas},
+        }

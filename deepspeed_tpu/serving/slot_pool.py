@@ -40,8 +40,12 @@ class SlotPool:
         self.spec = spec
         self.num_slots = num_slots
         self.capacity = int(spec.max_seq_len)
-        # replicated sharding the owning engine's jitted steps emit;
-        # falls back to the global mesh for standalone pools
+        # sharding the owning engine's jitted steps emit: a single
+        # Sharding applied to every leaf, or a PER-LEAF resolver
+        # ``fn(key, leaf) -> Sharding`` (the parallel/axis_rules seam —
+        # k/v shard over (data, model) while ``index`` shards only over
+        # data); falls back to replicated-on-the-global-mesh for
+        # standalone pools
         if sharding is None and mesh_mod.has_mesh():
             sharding = NamedSharding(mesh_mod.get_mesh(), PartitionSpec())
         self._sharding = sharding
@@ -63,6 +67,14 @@ class SlotPool:
         self._admit_rows_jit = jax.jit(self._admit_rows, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
+    def _place_leaf(self, key: str, leaf):
+        """Commit one cache leaf to its sharding (see ``__init__``)."""
+        if self._sharding is None:
+            return leaf
+        sh = self._sharding(key, leaf) if callable(self._sharding) \
+            else self._sharding
+        return leaf if sh is None else jax.device_put(leaf, sh)
+
     def _fresh_cache(self) -> Dict[str, Any]:
         """Zeroed pool pytree, committed to the replicated sharding the
         engine's jitted steps emit. A bare ``jnp.zeros`` pool is
@@ -72,10 +84,10 @@ class SlotPool:
         committed) flow back in as the donated pool argument. Committing
         up front keeps each admit jit at exactly one executable for the
         pool's lifetime (the recompile watchdog pins this)."""
-        cache = {"cache_store": self.spec.stacked_cache(self.num_slots)}
+        store = self.spec.stacked_cache(self.num_slots)
         if self._sharding is not None:
-            cache = jax.device_put(cache, self._sharding)
-        return cache
+            store = {k: self._place_leaf(k, v) for k, v in store.items()}
+        return {"cache_store": store}
 
     def _index_from_mirror(self):
         """Device ``index`` rebuilt from the host mirror, committed like
@@ -86,7 +98,7 @@ class SlotPool:
         # and the mirror is mutated in place by later advance() calls
         idx = jnp.array(self.starts, copy=True)
         if self._sharding is not None:
-            idx = jax.device_put(idx, self._sharding)
+            idx = self._place_leaf("index", idx)
         return idx
 
     # ------------------------------------------------------------------

@@ -66,6 +66,18 @@ _NOMINAL_PEAKS = (1e12, 1e11)
 _MISSING = object()
 
 
+def _mesh_device_count() -> int:
+    """Devices participating in the active global mesh (1 when no mesh
+    is set — the single-chip default)."""
+    try:
+        from ..parallel import mesh as mesh_mod
+        if mesh_mod.has_mesh():
+            return int(mesh_mod.get_mesh().devices.size)
+    except Exception:
+        pass
+    return 1
+
+
 def resolve_peaks(device=None) -> Tuple[float, float]:
     """(peak_flops, peak_bytes_per_s) for the first local device."""
     kind = ""
@@ -122,11 +134,23 @@ class ProgramCostModel:
 
     def __init__(self, registry=None, peak_flops: Optional[float] = None,
                  peak_bytes_per_s: Optional[float] = None,
-                 hbm_tolerance: float = 0.01, kv_every: int = 16):
+                 hbm_tolerance: float = 0.01, kv_every: int = 16,
+                 num_devices: Optional[int] = None):
         pf, pb = resolve_peaks()
-        self.peak_flops = float(peak_flops) if peak_flops else pf
+        # normalize utilization by the mesh, not one chip: cost_analysis
+        # reports WHOLE-program flops/bytes, so on a sharded mesh the
+        # denominator is nominal-peak × participating devices — a TP=4
+        # run reporting single-chip MFU > 1.0 was the bug this fixes.
+        # Explicit peak_flops/peak_bytes_per_s overrides are taken as
+        # ALREADY aggregate (callers passing a measured system peak).
+        if num_devices is None:
+            num_devices = _mesh_device_count()
+        self.num_devices = max(1, int(num_devices))
+        self.peak_flops = (float(peak_flops) if peak_flops
+                           else pf * self.num_devices)
         self.peak_bytes_per_s = (float(peak_bytes_per_s)
-                                 if peak_bytes_per_s else pb)
+                                 if peak_bytes_per_s
+                                 else pb * self.num_devices)
         self.hbm_tolerance = float(hbm_tolerance)
         # KV reconciliation cadence in steps (drift is a slow leak, not
         # a per-step event; pull paths always reconcile fresh)
@@ -330,6 +354,7 @@ class ProgramCostModel:
                                  if flops > 0 else 0.0),
             "peak_flops": self.peak_flops,
             "peak_bytes_per_s": self.peak_bytes_per_s,
+            "num_devices": self.num_devices,
             "overhead_s": self.overhead_s,
             "harvest_s": self.harvest_ns / 1e9,
             "hbm": dict(self.hbm),

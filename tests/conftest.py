@@ -51,3 +51,35 @@ def eight_device_mesh():
     from deepspeed_tpu.parallel import initialize_mesh
 
     return initialize_mesh()
+
+
+@pytest.fixture
+def tp_mesh():
+    """Factory fixture for a ``(data, model)`` global mesh on the forced
+    multi-device CPU host: ``mesh = tp_mesh(data=4, model=2)`` builds the
+    mesh AND installs it as the process-global mesh (torn down by the
+    autouse ``_reset_global_mesh``).
+
+    This only works because of two environment settings made at the TOP
+    of this conftest, before JAX initializes a backend — repeat them in
+    any subprocess (bench arms, ``check_regression`` reruns) BEFORE its
+    local ``import jax``:
+
+    * ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` splits the
+      host CPU into 8 virtual XLA devices. It is read once at backend
+      initialization; exporting it after ``jax.devices()`` has run is a
+      silent no-op and every mesh axis comes up size 1.
+    * ``JAX_PLATFORMS=cpu`` must ride along: the forced host devices
+      exist only on the ``cpu`` platform, so on a machine where an
+      accelerator plugin force-selects itself the flag above would
+      otherwise do nothing — the combination is what pins the 8-device
+      topology tests rely on.
+    """
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    def _make(data: int = 8, model: int = 1):
+        mesh = mesh_mod.initialize_mesh(data=data, model=model)
+        mesh_mod.set_mesh(mesh)
+        return mesh
+
+    return _make

@@ -201,3 +201,52 @@ def test_check_tier_pragma_not_stale_in_lint_run():
     check = analyze_source(src, "<memory>", SHARDING_RULES)
     mixes = [f for f in check if f.rule == "placement-mix"]
     assert mixes and all(f.suppressed for f in mixes)
+
+
+# ------------------------------------------ axis-rules table pinning
+def test_axis_rules_module_silent_on_both_rules():
+    """The REAL rules table (parallel/axis_rules.py) must pass the
+    --check sharding tier clean: its mesh-axis names come from the same
+    mesh.py constants the analyzer pins against, and its resolution is
+    shape-aware (the divisibility guard lives in physical_spec), so a
+    finding here is always an analyzer FP regression."""
+    import pathlib
+
+    p = (pathlib.Path(__file__).resolve().parents[3]
+         / "deepspeed_tpu" / "parallel" / "axis_rules.py")
+    src = p.read_text()
+    assert _errors(src, "mesh-axis-unknown", str(p)) == []
+    assert _errors(src, "shard-indivisible", str(p)) == []
+
+
+def test_seeded_bad_axis_rule_spec_fires_mesh_axis_unknown():
+    """A typo'd mesh axis in a cache-placement spec — the mistake the
+    runtime validate_axis_rules guards — fires statically too, at the
+    repo path where the project universe (mesh.py) applies."""
+    src = (
+        "from jax.sharding import PartitionSpec\n"
+        "# a hand-rolled cache leaf placement with a typo'd TP axis\n"
+        "KV_SPEC = PartitionSpec(None, 'data', 'modle')\n")
+    (f,) = _errors(src, "mesh-axis-unknown",
+                   "deepspeed_tpu/parallel/fixture.py")
+    assert "modle" in f.message and "model" in f.message
+
+
+def test_seeded_indivisible_cache_placement_fires(tmp_path):
+    """A slot-pool cache leaf committed over a data axis that does not
+    divide the slot count — the shape physical_spec's divisibility
+    fallback exists to prevent — fires shard-indivisible when both
+    sizes are static."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import NamedSharding, PartitionSpec\n"
+        "DATA_AXIS = 'data'\n"
+        "def commit_cache():\n"
+        "    mesh = initialize_mesh(data=8)\n"
+        "    k = jnp.zeros((2, 6, 4, 8))  # 6 slots on a data=8 mesh\n"
+        "    return jax.device_put(\n"
+        "        k, NamedSharding(mesh, PartitionSpec(None, 'data')))\n")
+    p = str(tmp_path / "mod.py")
+    (f,) = _errors(src, "shard-indivisible", p)
+    assert "6 % 8" in f.message

@@ -186,3 +186,22 @@ def test_cli_inventory_signatures_reproducible(tmp_path):
     proc2 = _run(["--inventory", "--signatures"])
     assert proc2.returncode == 0
     assert json.loads(proc2.stdout)["programs"] == doc["programs"]
+
+
+def test_sharded_env_enumerates_identically_to_dense():
+    """The serving-tp sharded config (mesh_data=4, mesh_model=2) must
+    enumerate the EXACT signature set of its dense twin: a (data, model)
+    mesh moves array placements, never traced shapes — the recompile-
+    free tentpole invariant, pinned at the static-analysis layer. A
+    divergence here means a mesh knob leaked into a traced shape."""
+    envs = default_check_envs()
+    sharded = [e for e in envs if e.get("mesh_model", 1) > 1]
+    assert sharded, "default_check_envs lost the serving-tp sharded env"
+    (sharded_env,) = sharded
+    dense_env = {k: v for k, v in sharded_env.items()
+                 if k not in ("mesh_data", "mesh_model")}
+    assert dense_env in envs  # the dense twin ships in the same set
+    a = enumerate_union([dense_env], REPO)
+    b = enumerate_union([sharded_env], REPO)
+    assert a.findings == [] and b.findings == []
+    assert a.programs == b.programs

@@ -1,0 +1,131 @@
+"""Logical axis-rules table tests: shape-aware resolution must keep
+re-partitioning recompile-free and bitwise-safe — size-1 mesh axes
+normalize away (a TP=1 mesh resolves every rule to the replicated spec,
+the tentpole's bitwise-parity-by-construction pin), indivisible dims
+fall back to replicated (t5x), specs stay canonical (no trailing Nones,
+no duplicate axes), and a typo'd mesh-axis name raises at table
+construction instead of surfacing as a silent replicated placement."""
+
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel import (DEFAULT_AXIS_RULES, LogicalAxisRules,
+                                    cache_leaf_sharding, default_axis_rules,
+                                    initialize_mesh, physical_spec,
+                                    validate_axis_rules)
+
+
+@pytest.fixture
+def tp2_mesh(tp_mesh):
+    return tp_mesh(data=4, model=2)
+
+
+def test_validate_rejects_unknown_mesh_axis():
+    with pytest.raises(ValueError, match="outside the declared universe"):
+        validate_axis_rules((("heads", "modle"),))  # typo'd axis name
+    with pytest.raises(ValueError, match="non-empty"):
+        validate_axis_rules((("", "model"),))
+    validate_axis_rules(DEFAULT_AXIS_RULES)  # the shipped table is clean
+
+
+def test_tp1_mesh_resolves_everything_replicated(tp_mesh):
+    """On a model=1 mesh every model-axis rule normalizes to the
+    replicated spec — TP=1 placements are IDENTICAL to single-chip, so
+    bitwise parity holds by construction, not by luck."""
+    mesh = tp_mesh(data=8, model=1)
+    rules = default_axis_rules()
+    spec = rules.spec_for(("heads", "head_dim"), shape=(4, 8), mesh=mesh)
+    assert spec == P()
+    # the slots rule still engages: data=8 has size > 1
+    assert rules.spec_for(("slots",), shape=(8,), mesh=mesh) == P("data")
+
+
+def test_size1_axis_drops_and_spec_is_canonical(tp2_mesh):
+    """Resolved specs must compare EQUAL to what GSPMD stamps on jit
+    outputs: no trailing Nones, no size-1 axes, no duplicate axes —
+    a textually-different-but-equivalent committed spec forks every
+    donated-pool executable."""
+    # trailing replicated dims are trimmed: P("model") not P("model", None)
+    spec = physical_spec(("model", None), shape=(4, 8), mesh=tp2_mesh)
+    assert spec == P("model")
+    # a mesh axis used by an earlier dim is not repeated
+    spec = physical_spec(("model", "model"), shape=(4, 4), mesh=tp2_mesh)
+    assert spec == P("model")
+    # axis absent from the mesh resolves replicated, not KeyError
+    spec = physical_spec(("pipe", "model"), shape=(4, 4), mesh=tp2_mesh)
+    assert spec == P(None, "model")
+
+
+def test_divisibility_fallback(tp2_mesh):
+    """A dim the mapped axis size does not divide replicates for THAT
+    dim only (a 4-slot pool on a data=8 mesh keeps working)."""
+    rules = default_axis_rules()
+    # data=4 divides 8 slots -> sharded
+    assert rules.spec_for(("slots",), shape=(8,), mesh=tp2_mesh) \
+        == P("data")
+    # data=4 does not divide 6 slots -> replicated
+    assert rules.spec_for(("slots",), shape=(6,), mesh=tp2_mesh) == P()
+    # model=2 divides heads=4 but not head_dim... other dims unaffected
+    assert rules.spec_for(("heads", None), shape=(4, 7), mesh=tp2_mesh) \
+        == P("model")
+
+
+def test_first_match_wins_ordering():
+    rules = LogicalAxisRules((("heads", "model"), ("heads", "data")))
+    assert rules.mesh_axis("heads") == "model"
+    assert rules.mesh_axis("unknown-name") is None
+    assert rules.mesh_axis(None) is None
+
+
+def test_shape_rank_mismatch_raises(tp2_mesh):
+    with pytest.raises(ValueError, match="dims"):
+        default_axis_rules().spec_for(("slots",), shape=(4, 4),
+                                      mesh=tp2_mesh)
+
+
+def test_cache_leaf_sharding_stacked_and_paged(tp2_mesh):
+    """The pool seam resolves each serving-cache leaf's layout against
+    its ACTUAL shape: slot rows shard over data, paged stores stay
+    reachable from every data shard (pages replicated), head dims shard
+    over model when divisible."""
+    stacked = cache_leaf_sharding("stacked", mesh=tp2_mesh)
+    # (layers, slots, kv_heads, head_dim, positions): slots 8 % data 4
+    # == 0 and kv_heads 4 % model 2 == 0 -> both shard
+    k = np.zeros((2, 8, 4, 8, 16), np.float32)
+    sh = stacked("k", k)
+    assert isinstance(sh, NamedSharding)
+    assert sh.spec == P(None, "data", "model")
+    # the slot index vector rides the data axis — the same placement
+    # the engine commits its current-token twin to
+    assert stacked("index", np.zeros((8,), np.int32)).spec == P("data")
+    # unknown leaf key -> replicated, never a crash
+    assert stacked("unknown", k).spec == P()
+
+    paged = cache_leaf_sharding("paged", mesh=tp2_mesh)
+    # pages dim replicated by rule; kv_heads still shards over model
+    pk = np.zeros((2, 12, 4, 8, 16), np.float32)
+    assert paged("k", pk).spec == P(None, None, "model")
+    assert paged("table", np.zeros((8, 4), np.int32)).spec == P("data")
+
+
+def test_mesh_default_resolution_uses_global(tp_mesh):
+    """spec_for with no mesh argument resolves against the installed
+    global mesh — the construction-time path the pools use."""
+    tp_mesh(data=8, model=1)
+    assert default_axis_rules().spec_for(("slots",), shape=(8,)) \
+        == P("data")
+
+
+def test_build_mesh_device_subsets():
+    """Disjoint device subsets build disjoint meshes — the DP router's
+    per-replica placement substrate."""
+    import jax
+
+    from deepspeed_tpu.parallel.mesh import build_mesh
+
+    devs = jax.devices()
+    m_a = build_mesh(devices=devs[:4], data=4, model=1)
+    m_b = build_mesh(devices=devs[4:], data=4, model=1)
+    assert set(m_a.devices.flat).isdisjoint(set(m_b.devices.flat))
+    assert dict(m_a.shape)["data"] == 4

@@ -279,3 +279,51 @@ def test_dump_without_dir_returns_none_and_never_raises(tmp_path):
     r.dump_dir = str(blocked)
     assert r.dump("stalled") is None
     assert r.dump_failures == 1
+
+
+# --------------------------------------------- mesh-normalized peaks
+def test_cost_model_peaks_scale_with_mesh_device_count():
+    """cost_analysis reports WHOLE-program flops/bytes, so on a sharded
+    mesh the MFU/bandwidth denominators must be nominal-peak x
+    participating devices — a TP=4 run reporting single-chip MFU > 1.0
+    was the bug this normalization fixes."""
+    from deepspeed_tpu.telemetry.costs import (ProgramCostModel,
+                                               resolve_peaks)
+
+    pf, pb = resolve_peaks()
+    one = ProgramCostModel(num_devices=1)
+    four = ProgramCostModel(num_devices=4)
+    assert one.peak_flops == pytest.approx(pf)
+    assert four.peak_flops == pytest.approx(4 * pf)
+    assert four.peak_bytes_per_s == pytest.approx(4 * pb)
+    assert four.summary()["num_devices"] == 4
+
+
+def test_cost_model_autodetects_global_mesh():
+    """num_devices=None resolves against the installed global mesh at
+    construction (1 with no mesh — the single-chip default)."""
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.telemetry.costs import ProgramCostModel
+
+    assert ProgramCostModel().num_devices == 1  # no mesh installed
+    mesh_mod.set_mesh(mesh_mod.initialize_mesh(data=4, model=2))
+    try:
+        assert ProgramCostModel().num_devices == 8
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_cost_model_explicit_peaks_stay_aggregate():
+    """Caller-supplied peaks are a MEASURED system aggregate: the mesh
+    multiplier must not double-scale them."""
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.telemetry.costs import ProgramCostModel
+
+    mesh_mod.set_mesh(mesh_mod.initialize_mesh(data=8))
+    try:
+        m = ProgramCostModel(peak_flops=123.0, peak_bytes_per_s=45.0)
+        assert m.peak_flops == 123.0
+        assert m.peak_bytes_per_s == 45.0
+        assert m.num_devices == 8  # recorded for attribution regardless
+    finally:
+        mesh_mod.reset_mesh()
