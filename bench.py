@@ -88,7 +88,13 @@ HTTP -> bridge -> step-thread path (``--require-zero-leaks`` +
 ``check_regression.py`` diffs two such files and gates on named
 metrics (and on ``detail.recompiles_after_warmup`` via
 ``--max-recompiles`` — every serving row reports it from the runtime
-recompile watchdog after a post-run warm replay).
+recompile watchdog after a post-run warm replay).  The static side of
+the same gate is ``--lint-json`` (repeatable): an all-tiers
+``bin/graftlint --json`` report plus a ``bin/graftlint --tier own
+deepspeed_tpu/serving --json`` ownership report, both held at
+``--max-lint-errors 0`` — the lifecycle invariants the chaos row
+audits at runtime are proven on every exception path before the row
+runs.
 
 ``--trace <path>`` additionally writes a Chrome trace-event / Perfetto
 JSON timeline (open at ui.perfetto.dev) for the row: serving rows run

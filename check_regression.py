@@ -71,12 +71,16 @@ errors) to be at most N — the serving gate runs with N=0.  Like
 ``--max-recompiles`` this is an absolute cap on the candidate alone: a
 static invariant violation is a defect, not a regression to be
 thresholded.  ``--max-lint-errors`` without ``--lint-json`` is a usage
-error (exit 2)::
+error (exit 2).  ``--lint-json`` repeats: the serving gate passes one
+all-tiers report plus a graftown (``--tier own``) ownership report over
+serving/, so a lifecycle finding and a trace-safety finding gate
+identically::
 
     bin/graftlint deepspeed_tpu/serving deepspeed_tpu/telemetry \
         --json > LINT.json
+    bin/graftlint --tier own deepspeed_tpu/serving --json > OWN.json
     python check_regression.py BASE.json CAND.json \
-        --lint-json LINT.json --max-lint-errors 0
+        --lint-json LINT.json --lint-json OWN.json --max-lint-errors 0
 
 ``--require-signature-match`` gates the zero-recompile invariant
 STATICALLY: it reads the ``signatures.json`` warmup manifest named by

@@ -21,6 +21,15 @@ map) — and five async-safety rules on top of it:
 ``unsafe-future-resolution``, ``await-while-holding-lock``, and
 ``unguarded-shared-write`` (catalog: :mod:`.concurrency_rules`).
 
+The **graftown** tier (``--tier own``, on by default) infers a
+resource-effect summary per function from a declarative effect table
+of the serving primitives (slot/page/seat/future/lock — ``--effects``
+dumps the inferred map) and walks each function's control flow
+including exception edges to prove the lifecycle invariants that
+``check_invariants()`` audits at runtime: ``leak-on-exception-path``,
+``double-release``, ``use-after-release``, ``unbalanced-refcount``,
+and ``missing-rollback`` (catalog: :mod:`.ownership_rules`).
+
 See ``bin/graftlint`` for the CLI and the "Static analysis" section of
 the README for the rule catalog, pragma syntax and baseline workflow.
 Findings are suppressed per line with::
@@ -38,19 +47,24 @@ from .concurrency_rules import SYNC_RULE_IDS, SYNC_RULES  # noqa: F401
 from .findings import ERROR, INFO, WARNING, Finding  # noqa: F401
 from .interp import (default_check_envs, diff_manifest,  # noqa: F401
                      enumerate_signatures, enumerate_union)
+from .ownership import (EFFECT_TABLE, RUNTIME_AUDIT,  # noqa: F401
+                        EffectMap, effect_table_dict)
+from .ownership_rules import OWN_RULE_IDS, OWN_RULES  # noqa: F401
 from .pragmas import PragmaIndex  # noqa: F401
 from .rules import ALL_RULES, META_RULES, RULES_BY_ID  # noqa: F401
 from .runner import (DEFAULT_RULES, Report, analyze_paths,  # noqa: F401
-                     analyze_source, check_paths, iter_python_files,
-                     jit_inventory, thread_inventory)
+                     analyze_source, check_paths, effect_inventory,
+                     iter_python_files, jit_inventory, thread_inventory)
 from .sharding_rules import CHECK_RULE_IDS, SHARDING_RULES  # noqa: F401
 
 __all__ = [
-    "ALL_RULES", "CHECK_RULE_IDS", "DEFAULT_RULES", "META_RULES",
-    "RULES_BY_ID", "SYNC_RULES", "SYNC_RULE_IDS", "ERROR",
-    "WARNING", "INFO", "Finding", "PragmaIndex", "Report",
+    "ALL_RULES", "CHECK_RULE_IDS", "DEFAULT_RULES", "EFFECT_TABLE",
+    "META_RULES", "OWN_RULES", "OWN_RULE_IDS", "RULES_BY_ID",
+    "RUNTIME_AUDIT", "SYNC_RULES", "SYNC_RULE_IDS", "ERROR",
+    "WARNING", "INFO", "EffectMap", "Finding", "PragmaIndex", "Report",
     "ThreadContextMap", "analyze_paths",
     "analyze_source", "check_paths", "default_check_envs", "diff_manifest",
-    "enumerate_signatures", "enumerate_union", "iter_python_files",
+    "effect_inventory", "effect_table_dict", "enumerate_signatures",
+    "enumerate_union", "iter_python_files",
     "jit_inventory", "load_baseline", "thread_inventory", "write_baseline",
 ]

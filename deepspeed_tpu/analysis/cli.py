@@ -15,9 +15,10 @@ from typing import List, Optional
 
 from .baseline import write_baseline
 from .concurrency_rules import SYNC_RULES
+from .ownership_rules import OWN_RULES
 from .rules import ALL_RULES, META_RULES
-from .runner import analyze_paths, check_paths, jit_inventory, \
-    thread_inventory
+from .runner import analyze_paths, check_paths, effect_inventory, \
+    jit_inventory, thread_inventory
 from .sharding_rules import SHARDING_RULES
 
 #: the CI gate: these trees hold at zero unsuppressed errors
@@ -66,15 +67,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--max-errors", type=int, default=0, metavar="N",
                     help="tolerated unsuppressed+unbaselined errors "
                          "(default 0)")
-    ap.add_argument("--tier", choices=("all", "lint", "sync"),
+    ap.add_argument("--tier", choices=("all", "lint", "sync", "own"),
                     default="all",
                     help="rule tier: 'lint' = trace-safety rules only, "
                          "'sync' = graftsync thread-context/async-safety "
-                         "rules only, 'all' (default) = both")
+                         "rules only, 'own' = graftown ownership/"
+                         "exception-path rules only, 'all' (default) = "
+                         "every tier")
     ap.add_argument("--threads", action="store_true",
                     help="print the inferred thread-context map "
                          "(qualname -> LOOP|ENGINE|BOTH|EXECUTOR) as "
                          "JSON and exit (graftsync drift check)")
+    ap.add_argument("--effects", action="store_true",
+                    help="print the graftown effect table plus every "
+                         "inferred per-function resource-effect summary "
+                         "as JSON and exit (ownership drift check)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     ap.add_argument("--inventory", action="store_true",
@@ -109,6 +116,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{r.id:22s} [{r.severity}] {r.short}")
         for r in SYNC_RULES:
             print(f"{r.id:26s} [{r.severity}] {r.short}  (sync tier)")
+        for r in OWN_RULES:
+            print(f"{r.id:26s} [{r.severity}] {r.short}  (own tier)")
         for r in SHARDING_RULES:
             print(f"{r.id:22s} [{r.severity}] {r.short}  (--check)")
         for rid in INTERP_RULE_IDS:
@@ -118,7 +127,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rid:22s} [meta]  {desc}")
         return 0
 
-    known = {r.id for r in ALL_RULES} | {r.id for r in SYNC_RULES}
+    known = {r.id for r in ALL_RULES} | {r.id for r in SYNC_RULES} \
+        | {r.id for r in OWN_RULES}
     if check_tier:
         known |= {r.id for r in SHARDING_RULES} | set(INTERP_RULE_IDS)
         if args.tier != "all":
@@ -144,6 +154,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"graftlint: no such path: {e}", file=sys.stderr)
             return 2
         print(json.dumps({"version": 1, "files": tmap},
+                         indent=2, sort_keys=True))
+        return 0
+
+    if args.effects:
+        try:
+            emap = effect_inventory(paths)
+        except FileNotFoundError as e:
+            print(f"graftlint: no such path: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps({"version": 1, **emap},
                          indent=2, sort_keys=True))
         return 0
 
@@ -195,11 +215,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                                  ignore=args.ignore or None,
                                  baseline=args.baseline)
         else:
-            tier_rules = None            # "all": lint + sync
+            tier_rules = None            # "all": lint + sync + own
             if args.tier == "lint":
                 tier_rules = ALL_RULES
             elif args.tier == "sync":
                 tier_rules = SYNC_RULES
+            elif args.tier == "own":
+                tier_rules = OWN_RULES
             report = analyze_paths(paths, select=args.select or None,
                                    ignore=args.ignore or None,
                                    baseline=args.baseline,

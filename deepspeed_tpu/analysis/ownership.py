@@ -1,0 +1,1126 @@
+"""graftown — static ownership & exception-path resource analysis.
+
+The serving stack's costliest runtime failures are lifecycle bugs: a
+slot allocated and never released on a raise path, a page refcount that
+drifts, state mutated under a ``try`` whose handler forgets to roll it
+back.  Today those are caught (late) by ``check_invariants()`` /
+``consistency_errors()`` sweeps and chaos tests; graftown proves the
+same class of invariant *statically*, before anything runs, the way
+graftlint did for trace safety and graftsync for thread contexts.
+
+Three layers, all stdlib ``ast`` over :class:`~.dataflow.ModuleIndex`
+(no jax import — the tier must gate CI in milliseconds):
+
+* :data:`EFFECT_TABLE` — a declarative catalog of the repo's resource
+  primitives: which method names acquire, release, ref/unref or
+  transfer each resource *kind* (slot, page, seat, future, lock).  Add
+  a kind by adding a table entry plus a :data:`RUNTIME_AUDIT` pointer
+  to its runtime sweep (a drift test pins both directions).
+* :class:`EffectMap` — per-function resource-effect summaries inferred
+  from the table and propagated transitively through helper calls to a
+  fixpoint (``_evict_slot(req)`` *releases* ``req.slot``, so every
+  caller of ``_evict_slot`` inherits that release).  ``--effects``
+  dumps the result as reproducible JSON.
+* :func:`analyze_functions` — a bounded path-sensitive walk of each
+  function's control flow **including exception edges**: every
+  may-raise call site forks an exception edge to the innermost
+  ``except``/``finally`` (or the function's exception exit), ``If``
+  arms fork with condition memoisation (two ``if cond:`` guards with
+  the same test take the same arm on one path, which is what keeps
+  "conditional acquire matched by the same-condition release" silent),
+  loops run zero-or-once.  The walk tracks handle states
+  (live/released/escaped) and emits the raw findings behind the five
+  graftown rules (catalog: :mod:`.ownership_rules`).
+
+Modeling choices (deliberate, documented so triage stays explainable):
+
+* Release-category calls (``release``/``unref_page``/``set_result``)
+  are modeled as non-raising: their runtime guards raise only on the
+  misuse (double free) that the static tier flags directly, and
+  treating them as may-raise would flag every rollback handler.
+* ``assert``, ``del``, subscript reads and a small safe-call whitelist
+  (``len``, ``dict.get``, ``list.append``, ...) are non-raising;
+  every other call may raise.
+* Container sinks (``.put``/``.append``/``.add``/...) are also
+  non-raising: a handoff into an in-process container failing *between*
+  acquire and enqueue is not a realistic leak class (unbounded
+  ``queue.put`` never raises), and modeling it flags every
+  future-then-enqueue bridge idiom.
+* A handle *escapes* (tracking stops) when stored into an attribute,
+  container or subscript target, passed to a container sink
+  (``.put``/``.append``/...), passed to a transfer-category call (the
+  prefix-trie handoff), returned, or passed to a helper whose summary
+  transfers it.  A plain pass-as-argument is NOT an escape.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field, replace
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .dataflow import FuncInfo, FunctionNode, ModuleIndex, node_path
+
+# ------------------------------------------------------------ effect table
+
+#: resource kind -> effect category -> method names.  The names are the
+#: repo's primitives (SlotPool / PagedKVPool / PrefixCache / scheduler /
+#: bridge); receiver heuristics disambiguate collisions (see
+#: :func:`classify_call`).
+EFFECT_TABLE: Dict[str, Dict[str, Tuple[str, ...]]] = {
+    "slot": {
+        "acquire": ("alloc",),
+        "release": ("release",),
+        "release_all": ("reset",),
+        "use": ("admit", "admit_rows", "advance", "reset_row",
+                "ensure_writable", "seat_prefix", "map_prefix",
+                "cache_prefix", "run_prefill_chunk"),
+    },
+    "page": {
+        "acquire": ("alloc_page",),
+        "ref": ("ref_page",),
+        "unref": ("unref_page",),
+        "transfer": ("insert", "map_prefix", "seat_prefix"),
+    },
+    "seat": {
+        "acquire": ("grant",),
+        "release": ("requeue_front", "requeue_back", "expire"),
+        "use": ("submit",),
+    },
+    "future": {
+        "acquire": ("create_future",),
+        "release": ("set_result", "set_exception"),
+    },
+    "lock": {
+        "acquire": ("acquire",),
+        "release": ("release",),
+    },
+}
+
+#: kinds whose handles the path walk tracks.  ``seat`` is inventory-only:
+#: ``grant()`` returns a *batch* whose choreography (requeue vs admit vs
+#: abort) is the engine's step contract, audited at runtime by
+#: ``check_invariants`` — per-handle tracking would only produce noise.
+TRACKED_KINDS = frozenset({"slot", "page", "future", "lock"})
+
+#: static kind -> the runtime audit(s) covering the same resource, as
+#: ``Class.method`` names in ``deepspeed_tpu/serving``.  The inventory
+#: test pins BOTH directions: every kind has an entry here, and every
+#: runtime ``check_invariants``/``consistency_errors`` definition is
+#: claimed by some kind — a new pool resource cannot silently skip the
+#: static tier.  ``lock`` has no runtime sweep (with-statement
+#: balancing is by construction); the static tier is its only auditor.
+RUNTIME_AUDIT: Dict[str, Tuple[str, ...]] = {
+    "slot": ("SlotPool.consistency_errors",
+             "ServingEngine.check_invariants"),
+    "page": ("PagedKVPool.consistency_errors",),
+    "seat": ("ServingEngine.check_invariants",
+             "ReplicaRouter.check_invariants"),
+    "future": ("AsyncEngineBridge._reject_pending_ops",),
+    "lock": (),
+}
+
+#: receiver-path components that mark a ``.acquire()``/``.release()``
+#: pair as a lock, not a slot (``self._lock.release()`` vs
+#: ``self.pool.release(slot)``)
+_LOCKISH = ("lock", "cond", "sem", "mutex")
+
+#: calls modeled as non-raising (see module docstring)
+_SAFE_BUILTINS = frozenset({
+    "len", "int", "float", "bool", "str", "repr", "min", "max", "abs",
+    "sum", "any", "all", "sorted", "list", "tuple", "dict", "set",
+    "frozenset", "enumerate", "zip", "range", "reversed", "isinstance",
+    "issubclass", "getattr", "hasattr", "id", "print", "format",
+    "round", "callable", "iter", "next", "vars", "type",
+})
+_SAFE_METHODS = frozenset({
+    "append", "appendleft", "extend", "add", "discard", "clear",
+    "copy", "get", "items", "keys", "values", "update", "setdefault",
+    "count", "index", "join", "split", "strip", "startswith",
+    "endswith", "is_alive", "is_set", "time", "monotonic",
+    "perf_counter", "debug", "info", "warning", "error",
+})
+#: method names whose arguments land in a container the caller no
+#: longer owns — passing a handle here is an ownership handoff
+_SINK_METHODS = frozenset({
+    "put", "put_nowait", "append", "appendleft", "add", "insert",
+    "extend", "push", "setdefault", "update",
+})
+
+#: request-lifecycle fields the missing-rollback rule tracks: mutated
+#: under a ``try`` whose handler re-raises, they must be restored (any
+#: assignment to the same field in handler or ``finally``) before the
+#: exception escapes — the PR-6 snapshot-rollback design rule
+ROLLBACK_FIELDS = frozenset({"state", "slot", "prefill_pos",
+                             "admit_time", "first_token_time"})
+
+# handle states
+LIVE = "live"
+RELEASED = "released"
+ESCAPED = "escaped"
+
+#: per-function path budget; beyond it forks stop and exit-based
+#: findings on the truncated paths are dropped (site-based findings
+#: already emitted are kept)
+MAX_PATHS = 2048
+
+
+# ------------------------------------------------------- call classification
+
+#: method name -> [(kind, category)] built from the table
+_METHOD_EFFECTS: Dict[str, List[Tuple[str, str]]] = {}
+for _kind, _cats in EFFECT_TABLE.items():
+    for _cat, _names in _cats.items():
+        for _n in _names:
+            _METHOD_EFFECTS.setdefault(_n, []).append((_kind, _cat))
+
+
+def _is_lockish(path: Optional[str]) -> bool:
+    if not path:
+        return False
+    low = path.lower()
+    return any(k in low for k in _LOCKISH)
+
+
+def classify_call(call: ast.Call) -> Optional[Tuple[str, str, str]]:
+    """``(kind, category, method)`` for an effect-table call, else None.
+
+    Collisions resolve on the receiver: ``release``/``acquire`` on a
+    lock-like path (``self._lock``) are the lock kind; ``acquire`` on
+    anything else is unclassified (only locks acquire in place);
+    ``release`` on anything else is the slot kind.
+    """
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    method = call.func.attr
+    cands = _METHOD_EFFECTS.get(method)
+    if not cands:
+        return None
+    recv = node_path(call.func.value)
+    lockish = _is_lockish(recv)
+    for kind, cat in cands:
+        if kind == "lock":
+            if lockish:
+                return (kind, cat, method)
+            continue
+        if lockish:
+            continue
+        return (kind, cat, method)
+    return None
+
+
+def _handle_on_receiver(kind: str) -> bool:
+    """Locks and futures carry the effect on the receiver
+    (``lock.release()``); slots and pages pass the handle as the first
+    argument (``pool.release(slot)``)."""
+    return kind in ("lock", "future")
+
+
+# ------------------------------------------------------ function summaries
+
+@dataclass
+class FuncSummary:
+    """Transitive resource effects of calling one function."""
+    fi: FuncInfo
+    #: ``(param index, attr chain)`` paths released by a call
+    releases: Set[Tuple[int, Tuple[str, ...]]] = field(default_factory=set)
+    #: param indices whose argument escapes into storage
+    transfers: Set[int] = field(default_factory=set)
+    #: kind of a fresh handle this function returns, if any
+    acquires: Optional[str] = None
+    may_raise: bool = False
+
+    def nontrivial(self) -> bool:
+        return bool(self.releases or self.transfers or self.acquires)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "acquires": self.acquires,
+            "may_raise": self.may_raise,
+            "releases": sorted(
+                "arg%d%s" % (i, "".join("." + a for a in attrs))
+                for i, attrs in self.releases),
+            "transfers": sorted("arg%d" % i for i in self.transfers),
+        }
+
+
+def _own_stmts(fn: ast.AST) -> Iterator[ast.stmt]:
+    """Statements of ``fn`` recursively, without entering nested
+    function/class definitions."""
+    def rec(stmts: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+        for s in stmts:
+            yield s
+            if isinstance(s, FunctionNode + (ast.ClassDef,)):
+                continue
+            for fname in ("body", "orelse", "finalbody"):
+                v = getattr(s, fname, None)
+                if isinstance(v, list):
+                    yield from rec(v)
+            for h in getattr(s, "handlers", []) or []:
+                yield from rec(h.body)
+    yield from rec(getattr(fn, "body", []))
+
+
+def _expr_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Calls owned by ``stmt`` (not those of nested statements), in walk
+    order; descends into comprehensions but not lambdas."""
+    from .dataflow import stmt_exprs
+    for e in stmt_exprs(stmt):
+        for n in ast.walk(e):
+            if isinstance(n, ast.Lambda):
+                continue
+            if isinstance(n, ast.Call):
+                yield n
+
+
+class EffectMap:
+    """Per-function :class:`FuncSummary` for one module, inferred from
+    :data:`EFFECT_TABLE` and propagated through direct calls (bare
+    name / ``self.method()``) to a fixpoint."""
+
+    def __init__(self, index: ModuleIndex):
+        self.index = index
+        self.summaries: Dict[ast.AST, FuncSummary] = {}
+        self._by_name_module = {
+            fi.node.name: fi for fi in index.functions.values()
+            if fi.parent is None and isinstance(fi.node, FunctionNode)}
+        self._methods: Dict[Tuple[str, str], FuncInfo] = {}
+        for fi in index.functions.values():
+            if fi.class_name and isinstance(fi.node, FunctionNode):
+                self._methods[(fi.class_name, fi.node.name)] = fi
+        for fi in index.functions.values():
+            if isinstance(fi.node, FunctionNode):
+                self.summaries[fi.node] = FuncSummary(fi)
+        changed = True
+        iters = 0
+        while changed and iters < 20:
+            changed = False
+            iters += 1
+            for fi in self.index.functions.values():
+                if isinstance(fi.node, FunctionNode):
+                    if self._summarize(fi):
+                        changed = True
+
+    # -------------------------------------------------------- resolution
+    def resolve_callee(self, call: ast.Call, fi: FuncInfo
+                       ) -> Optional[FuncInfo]:
+        return self.index._resolve_callee(
+            call.func, fi, {}, self._by_name_module, self._methods)
+
+    def callee_summary(self, call: ast.Call, fi: FuncInfo
+                       ) -> Optional[FuncSummary]:
+        cal = self.resolve_callee(call, fi)
+        if cal is None:
+            return None
+        return self.summaries.get(cal.node)
+
+    @staticmethod
+    def arg_for_param(call: ast.Call, cal: FuncInfo, pidx: int
+                      ) -> Optional[ast.expr]:
+        """The call-site expression bound to the callee's ``pidx``-th
+        parameter, adjusting for the bound receiver of
+        ``self.method(...)`` calls."""
+        names = cal.param_names()
+        if pidx >= len(names):
+            return None
+        offset = 0
+        if cal.class_name and names and names[0] in ("self", "cls") \
+                and isinstance(call.func, ast.Attribute):
+            offset = 1
+        if pidx == 0 and offset == 1:
+            return call.func.value      # the receiver itself
+        k = pidx - offset
+        if 0 <= k < len(call.args):
+            a = call.args[k]
+            return None if isinstance(a, ast.Starred) else a
+        for kw in call.keywords:
+            if kw.arg == names[pidx]:
+                return kw.value
+        return None
+
+    # ----------------------------------------------------- summarization
+    def call_may_raise(self, call: ast.Call, fi: FuncInfo) -> bool:
+        """May-raise model for one call site (see module docstring)."""
+        eff = classify_call(call)
+        if eff is not None and eff[1] in ("release", "unref",
+                                          "release_all"):
+            return False
+        if isinstance(call.func, ast.Name):
+            if call.func.id in _SAFE_BUILTINS:
+                return False
+        elif isinstance(call.func, ast.Attribute):
+            m = call.func.attr
+            if m in _SAFE_METHODS:
+                return False
+            if m in _SINK_METHODS:
+                return False            # container handoff (see docstring)
+            if m == "pop" and len(call.args) >= 2:
+                return False            # dict.pop(key, default)
+        cal = self.resolve_callee(call, fi)
+        if cal is not None:
+            summ = self.summaries.get(cal.node)
+            if summ is not None:
+                return summ.may_raise
+        return True
+
+    def stmt_may_raise(self, stmt: ast.stmt, fi: FuncInfo) -> bool:
+        if isinstance(stmt, ast.Raise):
+            return True
+        if isinstance(stmt, (ast.Assert, ast.Delete)):
+            return False
+        return any(self.call_may_raise(c, fi)
+                   for c in _expr_calls(stmt))
+
+    def _summarize(self, fi: FuncInfo) -> bool:
+        """One summarization pass over ``fi``; True when the summary
+        grew (drives the fixpoint)."""
+        summ = self.summaries[fi.node]
+        params = fi.param_names()
+        pidx_of = {n: i for i, n in enumerate(params)}
+        # local name -> param-rooted dotted path ("slot" -> "req.slot")
+        alias: Dict[str, str] = {n: n for n in params}
+        releases: Set[Tuple[int, Tuple[str, ...]]] = set()
+        transfers: Set[int] = set()
+        acquires: Optional[str] = None
+        may_raise = False
+        acquired_locals: Dict[str, str] = {}   # name -> kind
+
+        def resolve_path(expr: ast.expr) -> Optional[str]:
+            p = node_path(expr)
+            if p is None:
+                return None
+            head, _, rest = p.partition(".")
+            head = alias.get(head, head)
+            return head + ("." + rest if rest else "")
+
+        def param_key(path: Optional[str]
+                      ) -> Optional[Tuple[int, Tuple[str, ...]]]:
+            if not path:
+                return None
+            parts = path.split(".")
+            if parts[0] not in pidx_of:
+                return None
+            return (pidx_of[parts[0]], tuple(parts[1:]))
+
+        for stmt in _own_stmts(fi.node):
+            if isinstance(stmt, FunctionNode + (ast.ClassDef,)):
+                continue
+            if not may_raise and self.stmt_may_raise(stmt, fi):
+                may_raise = True
+            for call in _expr_calls(stmt):
+                eff = classify_call(call)
+                if eff is not None:
+                    kind, cat, _m = eff
+                    if cat in ("release", "unref") and \
+                            kind in TRACKED_KINDS:
+                        if _handle_on_receiver(kind):
+                            operand: Optional[ast.expr] = call.func.value
+                        else:
+                            operand = call.args[0] if call.args else None
+                        key = param_key(resolve_path(operand)
+                                        if operand is not None else None)
+                        if key is not None:
+                            releases.add(key)
+                    if cat == "transfer":
+                        for a in call.args:
+                            key = param_key(resolve_path(a))
+                            if key is not None and not key[1]:
+                                transfers.add(key[0])
+                elif isinstance(call.func, ast.Attribute) and \
+                        call.func.attr in _SINK_METHODS:
+                    for a in call.args:
+                        key = param_key(resolve_path(a))
+                        if key is not None and not key[1]:
+                            transfers.add(key[0])
+                # transitive: helper summaries
+                cal = self.resolve_callee(call, fi)
+                if cal is not None:
+                    csum = self.summaries.get(cal.node)
+                    if csum is None:
+                        continue
+                    for pidx, attrs in csum.releases:
+                        arg = self.arg_for_param(call, cal, pidx)
+                        if arg is None:
+                            continue
+                        path = resolve_path(arg)
+                        key = param_key(
+                            (path + "." + ".".join(attrs)) if attrs
+                            else path) if path else None
+                        if key is not None:
+                            releases.add(key)
+                    for pidx in csum.transfers:
+                        arg = self.arg_for_param(call, cal, pidx)
+                        if arg is not None:
+                            key = param_key(resolve_path(arg))
+                            if key is not None and not key[1]:
+                                transfers.add(key[0])
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                val_path = resolve_path(stmt.value)
+                if val_path is not None:
+                    alias[name] = val_path
+                else:
+                    alias.pop(name, None)
+                if isinstance(stmt.value, ast.Call):
+                    eff = classify_call(stmt.value)
+                    if eff and eff[1] == "acquire" and \
+                            eff[0] in TRACKED_KINDS:
+                        acquired_locals[name] = eff[0]
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                if isinstance(stmt.value, ast.Call):
+                    eff = classify_call(stmt.value)
+                    if eff and eff[1] == "acquire" and \
+                            eff[0] in TRACKED_KINDS:
+                        acquires = eff[0]
+                elif isinstance(stmt.value, ast.Name) and \
+                        stmt.value.id in acquired_locals:
+                    acquires = acquired_locals[stmt.value.id]
+
+        grew = (not releases <= summ.releases
+                or not transfers <= summ.transfers
+                or (acquires is not None and summ.acquires is None)
+                or (may_raise and not summ.may_raise))
+        summ.releases |= releases
+        summ.transfers |= transfers
+        summ.acquires = summ.acquires or acquires
+        summ.may_raise = summ.may_raise or may_raise
+        return grew
+
+    # ----------------------------------------------------------- export
+    def labels(self) -> Dict[str, Dict[str, object]]:
+        """``qualname -> summary`` for every function with a nontrivial
+        resource effect — deterministic, the ``--effects`` payload."""
+        out: Dict[str, Dict[str, object]] = {}
+        for summ in self.summaries.values():
+            if summ.nontrivial():
+                out[summ.fi.qualname] = summ.to_dict()
+        return dict(sorted(out.items()))
+
+
+def effect_table_dict() -> Dict[str, Dict[str, List[str]]]:
+    """The declarative table as sorted JSON-able dict (``--effects``)."""
+    return {k: {c: sorted(n) for c, n in sorted(cats.items())}
+            for k, cats in sorted(EFFECT_TABLE.items())}
+
+
+# ------------------------------------------------------------ path analysis
+
+@dataclass
+class Handle:
+    kind: str
+    state: str
+    node: ast.AST               # acquire site (or first release site for
+    path: Optional[str] = None  # param-rooted path handles)
+    implicit: bool = False      # created by releasing a path we never
+    #                             saw acquired (double-release tracking)
+
+
+class _State:
+    """One path's view: handle table, name/path bindings, memoized
+    branch conditions."""
+
+    __slots__ = ("handles", "bindings", "paths", "aliases", "conds")
+
+    def __init__(self) -> None:
+        self.handles: Dict[int, Handle] = {}
+        self.bindings: Dict[str, int] = {}   # local name -> handle id
+        self.paths: Dict[str, int] = {}      # dotted path -> handle id
+        self.aliases: Dict[str, str] = {}    # local name -> dotted path
+        self.conds: Dict[str, bool] = {}     # ast.dump(test) -> branch
+
+    def clone(self) -> "_State":
+        st = _State.__new__(_State)
+        st.handles = {k: replace(v) for k, v in self.handles.items()}
+        st.bindings = dict(self.bindings)
+        st.paths = dict(self.paths)
+        st.aliases = dict(self.aliases)
+        st.conds = dict(self.conds)
+        return st
+
+    def sig(self) -> Tuple:
+        return (tuple(sorted((k, v.state) for k, v in
+                             self.handles.items())),
+                tuple(sorted(self.bindings.items())),
+                tuple(sorted(self.paths.items())))
+
+
+@dataclass
+class Outcome:
+    kind: str                   # "fall" | "return" | "raise" | "break"
+    state: _State               # | "continue" | "abandon"
+    origin: Optional[ast.AST] = None
+
+
+@dataclass
+class RawFinding:
+    rule: str
+    node: ast.AST
+    message: str
+    fi: FuncInfo
+
+
+class _Walker:
+    """Bounded path-sensitive walk of one function (see module
+    docstring for the modeling rules)."""
+
+    def __init__(self, fi: FuncInfo, emap: EffectMap):
+        self.fi = fi
+        self.emap = emap
+        self.findings: List[RawFinding] = []
+        self._emitted: Set[Tuple[str, int]] = set()
+        self._next_handle = 1
+        self._budget = MAX_PATHS
+        self._cond_names: Dict[str, Set[str]] = {}
+
+    # ------------------------------------------------------------ emit
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        key = (rule, getattr(node, "lineno", 0))
+        if key not in self._emitted:
+            self._emitted.add(key)
+            self.findings.append(RawFinding(rule, node, message, self.fi))
+
+    # ------------------------------------------------------- resolution
+    def _resolve_path(self, expr: ast.expr, st: _State) -> Optional[str]:
+        p = node_path(expr)
+        if p is None:
+            return None
+        head, _, rest = p.partition(".")
+        head = st.aliases.get(head, head)
+        return head + ("." + rest if rest else "")
+
+    def _handle_for(self, expr: ast.expr, st: _State) -> Optional[Handle]:
+        if isinstance(expr, ast.Name) and expr.id in st.bindings:
+            return st.handles.get(st.bindings[expr.id])
+        path = self._resolve_path(expr, st)
+        if path is not None and path in st.paths:
+            return st.handles.get(st.paths[path])
+        return None
+
+    def _new_handle(self, kind: str, state: str, node: ast.AST,
+                    st: _State, path: Optional[str] = None,
+                    implicit: bool = False) -> int:
+        hid = self._next_handle
+        self._next_handle += 1
+        st.handles[hid] = Handle(kind, state, node, path, implicit)
+        if path is not None:
+            st.paths[path] = hid
+        return hid
+
+    # ------------------------------------------------------ call events
+    def _release_event(self, call: ast.Call, operand: Optional[ast.expr],
+                       kind: str, st: _State) -> None:
+        h = self._handle_for(operand, st) if operand is not None else None
+        if h is not None:
+            if h.state == RELEASED:
+                self._emit(
+                    "double-release", call,
+                    f"{h.kind} handle released twice on one path "
+                    f"(first release survives from line "
+                    f"{getattr(h.node, 'lineno', '?')}) — generalizes "
+                    f"the runtime double-free guard to a static error")
+            elif h.state == LIVE:
+                h.state = RELEASED
+                h.node = call
+            return
+        if operand is None:
+            return
+        path = self._resolve_path(operand, st)
+        if path is not None:
+            # releasing a path we never saw acquired: start tracking so
+            # a second release of the same path is a definite double
+            self._new_handle(kind, RELEASED, call, st, path=path,
+                             implicit=True)
+
+    def _use_event(self, call: ast.Call, kind: str, st: _State) -> None:
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            h = self._handle_for(a, st)
+            if h is not None and h.kind == kind and h.state == RELEASED:
+                self._emit(
+                    "use-after-release", call,
+                    f"{kind} handle passed to effectful call after its "
+                    f"release on this path (released at line "
+                    f"{getattr(h.node, 'lineno', '?')})")
+
+    def _escape(self, expr: ast.expr, st: _State) -> None:
+        for n in ast.walk(expr):
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                h = self._handle_for(n, st)
+                if h is not None:
+                    h.state = ESCAPED
+
+    def _process_call(self, call: ast.Call, st: _State) -> None:
+        eff = classify_call(call)
+        if eff is not None:
+            kind, cat, _m = eff
+            if kind in TRACKED_KINDS:
+                if cat in ("release", "unref"):
+                    if _handle_on_receiver(kind):
+                        self._release_event(call, call.func.value, kind,
+                                            st)
+                    else:
+                        self._release_event(
+                            call, call.args[0] if call.args else None,
+                            kind, st)
+                elif cat == "ref" and call.args:
+                    # ref_page(pid): the +1 starts a tracked handle on
+                    # the operand path; unref or handoff balances it
+                    path = self._resolve_path(call.args[0], st)
+                    if path is not None and path not in st.paths:
+                        self._new_handle(kind, LIVE, call, st, path=path)
+                elif cat == "use":
+                    self._use_event(call, kind, st)
+                elif cat == "transfer":
+                    for a in call.args:
+                        self._escape(a, st)
+            elif cat == "use":
+                self._use_event(call, kind, st)
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _SINK_METHODS:
+            for a in call.args:
+                self._escape(a, st)
+        # transitive helper effects
+        cal = self.emap.resolve_callee(call, self.fi)
+        if cal is not None:
+            summ = self.emap.summaries.get(cal.node)
+            if summ is not None:
+                for pidx, attrs in summ.releases:
+                    arg = self.emap.arg_for_param(call, cal, pidx)
+                    if arg is None:
+                        continue
+                    base = self._resolve_path(arg, st)
+                    if base is None:
+                        continue
+                    path = ".".join((base,) + attrs) if attrs else base
+                    hid = st.paths.get(path)
+                    h = st.handles.get(hid) if hid is not None else None
+                    if h is not None and h.state == RELEASED:
+                        self._emit(
+                            "double-release", call,
+                            f"helper call releases `{path}` again on "
+                            f"this path (first release survives from "
+                            f"line {getattr(h.node, 'lineno', '?')})")
+                    elif h is not None and h.state == LIVE:
+                        h.state = RELEASED
+                        h.node = call
+                    elif h is None:
+                        self._new_handle("slot", RELEASED, call, st,
+                                         path=path, implicit=True)
+                for pidx in summ.transfers:
+                    arg = self.emap.arg_for_param(call, cal, pidx)
+                    if arg is not None:
+                        self._escape(arg, st)
+
+    # -------------------------------------------------------- statements
+    def _clear_path(self, path: str, st: _State) -> None:
+        """An assignment to ``path`` rebinds it: drop path tracking for
+        it and anything beneath it."""
+        for p in [p for p in st.paths
+                  if p == path or p.startswith(path + ".")]:
+            st.paths.pop(p, None)
+        for c in [c for c, names in list(self._cond_names.items())
+                  if path.split(".")[0] in names]:
+            st.conds.pop(c, None)
+
+    def _assign(self, stmt: ast.stmt, st: _State) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        for c in _expr_calls(stmt):
+            self._process_call(c, st)
+        hid: Optional[int] = None
+        val_path: Optional[str] = None
+        if isinstance(value, ast.Call):
+            eff = classify_call(value)
+            if eff and eff[1] == "acquire" and eff[0] in TRACKED_KINDS:
+                hid = self._new_handle(eff[0], LIVE, value, st)
+            else:
+                cal = self.emap.resolve_callee(value, self.fi)
+                summ = self.emap.summaries.get(cal.node) \
+                    if cal is not None else None
+                if summ is not None and summ.acquires:
+                    hid = self._new_handle(summ.acquires, LIVE, value, st)
+        elif value is not None:
+            if isinstance(value, ast.Name) and value.id in st.bindings:
+                hid = st.bindings[value.id]
+            val_path = self._resolve_path(value, st)
+        for t in targets:
+            flat = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for el in flat:
+                if isinstance(el, ast.Starred):
+                    el = el.value
+                if isinstance(el, ast.Name):
+                    st.bindings.pop(el.id, None)
+                    st.aliases.pop(el.id, None)
+                    self._clear_path(el.id, st)
+                    if hid is not None and len(flat) == 1:
+                        st.bindings[el.id] = hid
+                    elif val_path is not None and len(flat) == 1:
+                        st.aliases[el.id] = val_path
+                else:
+                    # attribute / subscript target: the stored value (and
+                    # any subscript key) escapes; the target path rebinds.
+                    # A fresh acquire stored straight into a container
+                    # (``slots[i] = pool.alloc()``) escapes the same way.
+                    if hid is not None:
+                        st.handles[hid].state = ESCAPED
+                    if value is not None:
+                        self._escape(value, st)
+                    if isinstance(el, ast.Subscript):
+                        self._escape(el.slice, st)
+                        tp = self._resolve_path(el.value, st)
+                    else:
+                        tp = self._resolve_path(el, st)
+                    if tp is not None:
+                        self._clear_path(tp, st)
+
+    def _leak_check(self, st: _State, origin: ast.AST) -> None:
+        for h in st.handles.values():
+            if h.state == LIVE and not h.implicit:
+                self._emit(
+                    "leak-on-exception-path", h.node,
+                    f"{h.kind} handle acquired here leaks when line "
+                    f"{getattr(origin, 'lineno', '?')} raises: the "
+                    f"exception escapes the function with no "
+                    f"except/finally releasing it on that path")
+
+    # ------------------------------------------------------ control flow
+    def walk_function(self) -> None:
+        st = _State()
+        outs = self._walk_seq(list(self.fi.node.body), st, trap=None)
+        for o in outs:
+            if o.kind == "raise":
+                self._leak_check(o.state, o.origin or self.fi.node)
+            if o.kind in ("fall", "return"):
+                for h in o.state.handles.values():
+                    if h.state == LIVE and not h.implicit and \
+                            h.kind == "page":
+                        self._emit(
+                            "unbalanced-refcount", h.node,
+                            "page acquired/ref'd here is neither "
+                            "unref'd nor handed off on some path "
+                            "through the function — the refcount "
+                            "drifts by +1")
+
+    def _walk_seq(self, stmts: List[ast.stmt], st: _State,
+                  trap: Optional[List[Tuple[_State, ast.AST]]]
+                  ) -> List[Outcome]:
+        """Walk ``stmts`` from state ``st``.  ``trap`` collects
+        (pre-statement state, origin) snapshots at may-raise sites when
+        inside a ``try`` body; outside any try a may-raise site is an
+        exception edge straight to the function's exception exit, so
+        live handles are leak-checked on the spot."""
+        if self._budget <= 0:
+            return [Outcome("abandon", st)]
+        out: List[Outcome] = []
+        states = [st]
+        for i, stmt in enumerate(stmts):
+            nxt: List[_State] = []
+            for s in states:
+                self._budget -= 1
+                if self._budget <= 0:
+                    out.append(Outcome("abandon", s))
+                    continue
+                if self.emap.stmt_may_raise(stmt, self.fi) and \
+                        not isinstance(stmt, ast.Raise):
+                    if trap is not None:
+                        trap.append((s.clone(), stmt))
+                    else:
+                        self._leak_check(s, stmt)
+                for o in self._walk_stmt(stmt, s, trap):
+                    if o.kind == "fall":
+                        nxt.append(o.state)
+                    else:
+                        out.append(o)
+            states = nxt
+            if not states:
+                return out
+        out.extend(Outcome("fall", s) for s in states)
+        return out
+
+    def _walk_stmt(self, stmt: ast.stmt, st: _State,
+                   trap: Optional[List[Tuple[_State, ast.AST]]]
+                   ) -> List[Outcome]:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if isinstance(stmt, ast.AugAssign) or \
+                    (isinstance(stmt, ast.AnnAssign)
+                     and stmt.value is None):
+                for c in _expr_calls(stmt):
+                    self._process_call(c, st)
+            else:
+                self._assign(stmt, st)
+            return [Outcome("fall", st)]
+        if isinstance(stmt, ast.Return):
+            for c in _expr_calls(stmt):
+                self._process_call(c, st)
+            if stmt.value is not None:
+                if isinstance(stmt.value, ast.Call):
+                    eff = classify_call(stmt.value)
+                    if not (eff and eff[1] == "acquire"):
+                        self._escape(stmt.value, st)
+                else:
+                    self._escape(stmt.value, st)
+            return [Outcome("return", st)]
+        if isinstance(stmt, ast.Raise):
+            for c in _expr_calls(stmt):
+                self._process_call(c, st)
+            return [Outcome("raise", st, stmt)]
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return [Outcome("break" if isinstance(stmt, ast.Break)
+                            else "continue", st)]
+        if isinstance(stmt, ast.If):
+            return self._walk_if(stmt, st, trap)
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            return self._walk_loop(stmt, st, trap)
+        if isinstance(stmt, ast.Try):
+            return self._walk_try(stmt, st, trap)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                for n in ast.walk(item.context_expr):
+                    if isinstance(n, ast.Call):
+                        self._process_call(n, st)
+            return self._walk_seq(list(stmt.body), st, trap)
+        if isinstance(stmt, FunctionNode + (ast.ClassDef,)):
+            return [Outcome("fall", st)]
+        for c in _expr_calls(stmt):
+            self._process_call(c, st)
+        return [Outcome("fall", st)]
+
+    def _walk_if(self, stmt: ast.If, st: _State,
+                 trap) -> List[Outcome]:
+        key = ast.dump(stmt.test)
+        names = {n.id for n in ast.walk(stmt.test)
+                 if isinstance(n, ast.Name)}
+        self._cond_names[key] = names
+        for c in _expr_calls(ast.Expr(value=stmt.test)):
+            self._process_call(c, st)
+        if key in st.conds:
+            branch = stmt.body if st.conds[key] else stmt.orelse
+            return self._walk_seq(list(branch), st, trap)
+        out: List[Outcome] = []
+        st2 = st.clone()
+        st.conds[key] = True
+        st2.conds[key] = False
+        out.extend(self._walk_seq(list(stmt.body), st, trap))
+        out.extend(self._walk_seq(list(stmt.orelse), st2, trap))
+        return out
+
+    def _walk_loop(self, stmt, st: _State, trap) -> List[Outcome]:
+        """Loops run zero-or-once; ``while True`` cannot run zero times
+        and a fall off the end of its single modeled iteration abandons
+        the path (no exit exists to check)."""
+        infinite = isinstance(stmt, ast.While) and \
+            isinstance(stmt.test, ast.Constant) and stmt.test.value
+        for c in _expr_calls(ast.Expr(value=getattr(
+                stmt, "test", None) or getattr(stmt, "iter", None))):
+            self._process_call(c, st)
+        out: List[Outcome] = []
+        body_st = st.clone() if not infinite else st
+        if not infinite:
+            out.extend(self._walk_seq(list(stmt.orelse), st, trap)
+                       if stmt.orelse else [Outcome("fall", st)])
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._assign_loop_target(stmt.target, body_st)
+        for o in self._walk_seq(list(stmt.body), body_st, trap):
+            if o.kind in ("break", "continue", "fall"):
+                if infinite and o.kind in ("continue", "fall"):
+                    out.append(Outcome("abandon", o.state))
+                else:
+                    out.append(Outcome("fall", o.state))
+            else:
+                out.append(o)
+        return out
+
+    def _assign_loop_target(self, target: ast.expr, st: _State) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                st.bindings.pop(n.id, None)
+                st.aliases.pop(n.id, None)
+                self._clear_path(n.id, st)
+
+    def _walk_try(self, stmt: ast.Try, st: _State, trap) -> List[Outcome]:
+        inner: List[Tuple[_State, ast.AST]] = []
+        body_outs = self._walk_seq(list(stmt.body), st, inner)
+        falls = [o for o in body_outs if o.kind == "fall"]
+        raises = [o for o in body_outs if o.kind == "raise"]
+        others = [o for o in body_outs
+                  if o.kind not in ("fall", "raise")]
+
+        entry_states: List[Tuple[_State, Optional[ast.AST]]] = []
+        seen: Set[Tuple] = set()
+        for s, origin in inner:
+            sg = s.sig()
+            if sg not in seen:
+                seen.add(sg)
+                entry_states.append((s, origin))
+        for o in raises:
+            sg = o.state.sig()
+            if sg not in seen:
+                seen.add(sg)
+                entry_states.append((o.state, o.origin))
+
+        out: List[Outcome] = []
+        if stmt.handlers and entry_states:
+            for s, origin in entry_states:
+                for h in stmt.handlers:
+                    hs = s.clone()
+                    houts = self._walk_seq(list(h.body), hs, trap)
+                    for o in houts:
+                        if o.kind == "raise" and o.origin is not None \
+                                and isinstance(o.origin, ast.Raise) \
+                                and o.origin.exc is None:
+                            o = Outcome("raise", o.state,
+                                        origin or o.origin)
+                        out.append(o if o.kind != "fall"
+                                   else Outcome("fall", o.state))
+        elif not stmt.handlers:
+            # try/finally only: exceptions pass through
+            out.extend(Outcome("raise", s, origin)
+                       for s, origin in entry_states)
+
+        # orelse runs after a no-raise body
+        for o in falls:
+            if stmt.orelse:
+                out.extend(self._walk_seq(list(stmt.orelse), o.state,
+                                          trap))
+            else:
+                out.append(o)
+        out.extend(others)
+
+        if stmt.finalbody:
+            finalized: List[Outcome] = []
+            for o in out:
+                fouts = self._walk_seq(list(stmt.finalbody), o.state,
+                                       trap)
+                for fo in fouts:
+                    if fo.kind == "fall":
+                        finalized.append(Outcome(o.kind, fo.state,
+                                                 o.origin))
+                    else:
+                        finalized.append(fo)
+            out = finalized
+        return out
+
+
+# --------------------------------------------------------- missing-rollback
+
+def _attr_assigns(stmts: Sequence[ast.stmt]) -> List[Tuple[str, ast.AST]]:
+    """``(field, node)`` for every tracked-field attribute assignment in
+    ``stmts`` (recursive, tuple targets flattened, ``self`` excluded —
+    engine-global state rolls back via ``_abort_step``, which per-field
+    matching cannot see)."""
+    out: List[Tuple[str, ast.AST]] = []
+    for s in stmts:
+        if isinstance(s, FunctionNode + (ast.ClassDef,)):
+            continue
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = s.targets if isinstance(s, ast.Assign) \
+                else [s.target]
+            flat: List[ast.expr] = []
+            for t in targets:
+                flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+            for el in flat:
+                if isinstance(el, ast.Attribute) and \
+                        el.attr in ROLLBACK_FIELDS:
+                    base = node_path(el.value)
+                    if base and base.split(".")[0] not in ("self", "cls"):
+                        out.append((el.attr, el))
+        for fname in ("body", "orelse", "finalbody"):
+            v = getattr(s, fname, None)
+            if isinstance(v, list):
+                out.extend(_attr_assigns(
+                    [x for x in v if isinstance(x, ast.stmt)]))
+        for h in getattr(s, "handlers", []) or []:
+            out.extend(_attr_assigns(h.body))
+    return out
+
+
+def missing_rollback_findings(fi: FuncInfo, emap: EffectMap
+                              ) -> List[RawFinding]:
+    """Fire on the PR-6 shape gone wrong: a ``try`` whose handler
+    re-raises mutates a request-lifecycle field without restoring it
+    (any assignment to the same field in the handler or ``finally``)
+    before the exception escapes."""
+    out: List[RawFinding] = []
+    for node in _own_stmts(fi.node):
+        if not isinstance(node, ast.Try):
+            continue
+        rollback_handlers = [
+            h for h in node.handlers
+            if any(isinstance(x, ast.Raise) for x in _own_stmts_h(h))]
+        if not rollback_handlers:
+            continue
+        if not any(emap.stmt_may_raise(s, fi)
+                   for s in _shallow_stmts(node.body)):
+            continue
+        mutated = _attr_assigns(node.body)
+        if not mutated:
+            continue
+        restored: Set[str] = {f for f, _ in
+                              _attr_assigns(node.finalbody)}
+        for h in rollback_handlers:
+            restored_h = restored | {f for f, _ in _attr_assigns(h.body)}
+            for fld, site in mutated:
+                if fld not in restored_h:
+                    out.append(RawFinding(
+                        "missing-rollback", site,
+                        f"request field `.{fld}` is mutated under a "
+                        f"try whose handler re-raises without "
+                        f"restoring it — snapshot it before the try "
+                        f"and restore it in the except path "
+                        f"(PR-6 rollback rule)", fi))
+    return out
+
+
+def _own_stmts_h(h: ast.ExceptHandler) -> Iterator[ast.stmt]:
+    def rec(stmts):
+        for s in stmts:
+            yield s
+            if isinstance(s, FunctionNode + (ast.ClassDef,)):
+                continue
+            for fname in ("body", "orelse", "finalbody"):
+                v = getattr(s, fname, None)
+                if isinstance(v, list):
+                    yield from rec(v)
+            for hh in getattr(s, "handlers", []) or []:
+                yield from rec(hh.body)
+    yield from rec(h.body)
+
+
+def _shallow_stmts(stmts: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+    for s in stmts:
+        yield s
+        if isinstance(s, FunctionNode + (ast.ClassDef,)):
+            continue
+        for fname in ("body", "orelse"):
+            v = getattr(s, fname, None)
+            if isinstance(v, list):
+                yield from _shallow_stmts(
+                    [x for x in v if isinstance(x, ast.stmt)])
+
+
+# --------------------------------------------------------------- module API
+
+def _has_effect_calls(fn: ast.AST) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and classify_call(n) is not None:
+            return True
+    return False
+
+
+def analyze_functions(index: ModuleIndex) -> List[RawFinding]:
+    """All graftown raw findings for one module: the shared entry point
+    the five rules split by id (computed once, cached per file)."""
+    emap = EffectMap(index)
+    out: List[RawFinding] = []
+    for fi in index.functions.values():
+        if not isinstance(fi.node, FunctionNode):
+            continue
+        out.extend(missing_rollback_findings(fi, emap))
+        if not _has_effect_calls(fi.node):
+            continue
+        w = _Walker(fi, emap)
+        w.walk_function()
+        out.extend(w.findings)
+    return out

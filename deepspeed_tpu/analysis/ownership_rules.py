@@ -1,0 +1,97 @@
+"""graftown rule catalog — the ``--tier own`` ownership rules.
+
+Five path-sensitive rules over :mod:`.ownership`'s effect summaries and
+exception-edge path walk, each the static form of a runtime guard the
+repo already paid for once:
+
+* ``leak-on-exception-path`` — a resource acquired locally can reach
+  the function's exception exit still live (the ``check_invariants``
+  "leaked slots" sweep, moved to CI time).
+* ``double-release`` — a release reachable twice along one path (the
+  PR-2 ``SlotPool`` double-free RuntimeError, now a static error).
+* ``use-after-release`` — a released handle passed back into an
+  effectful call of the same kind on the same path.
+* ``unbalanced-refcount`` — a page acquired or ref'd whose refcount is
+  neither dropped nor handed off on some path (the PR-7 trie/CoW
+  ``consistency_errors`` class).
+* ``missing-rollback`` — request-lifecycle state mutated under a
+  ``try`` whose handler re-raises without restoring the field (the
+  PR-6 snapshot-rollback design rule).
+
+All five share one analysis pass, computed once per file and cached on
+the :class:`~.rules.ModuleContext` (the ``get_thread_map`` pattern).
+Suppressions use the house pragma with a mandatory reason::
+
+    # graftlint: allow[leak-on-exception-path] -- ownership transferred
+    #     to the retry queue two frames up
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+from .findings import ERROR, Finding
+from .ownership import RawFinding, analyze_functions
+from .rules import ModuleContext, Rule
+
+
+def get_ownership(ctx: ModuleContext) -> Dict[str, List[RawFinding]]:
+    """Raw graftown findings for ``ctx``, bucketed by rule id; computed
+    once per file and cached on the context."""
+    cached = getattr(ctx, "_ownership", None)
+    if cached is None:
+        cached = {}
+        for rf in analyze_functions(ctx.index):
+            cached.setdefault(rf.rule, []).append(rf)
+        ctx._ownership = cached
+    return cached
+
+
+class _OwnRule(Rule):
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for rf in get_ownership(ctx).get(self.id, ()):
+            yield self.finding(ctx, rf.node, rf.message,
+                               func=rf.fi.qualname)
+
+
+class LeakOnExceptionPathRule(_OwnRule):
+    id = "leak-on-exception-path"
+    short = ("resource acquired, then an escaping raise path reaches "
+             "the function exit without the matching release")
+
+
+class DoubleReleaseRule(_OwnRule):
+    id = "double-release"
+    short = ("release reachable twice along one path (static form of "
+             "the runtime double-free guard)")
+
+
+class UseAfterReleaseRule(_OwnRule):
+    id = "use-after-release"
+    short = ("released slot/page handle passed back into an effectful "
+             "call on the same path")
+
+
+class UnbalancedRefcountRule(_OwnRule):
+    id = "unbalanced-refcount"
+    short = ("page ref/alloc with no unref or ownership handoff on "
+             "some path through the function")
+
+
+class MissingRollbackRule(_OwnRule):
+    id = "missing-rollback"
+    short = ("request state mutated under a try whose handler "
+             "re-raises without restoring the field")
+
+
+OWN_RULES = (
+    LeakOnExceptionPathRule(),
+    DoubleReleaseRule(),
+    UseAfterReleaseRule(),
+    UnbalancedRefcountRule(),
+    MissingRollbackRule(),
+)
+
+OWN_RULE_IDS = {r.id for r in OWN_RULES}

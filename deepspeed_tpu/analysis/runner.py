@@ -20,15 +20,18 @@ from .concurrency import ThreadContextMap
 from .concurrency_rules import SYNC_RULES
 from .dataflow import ModuleIndex
 from .findings import ERROR, WARNING, Finding, assign_fingerprints
+from .ownership import EffectMap, effect_table_dict
+from .ownership_rules import OWN_RULES
 from .pragmas import PragmaIndex
 from .rules import ALL_RULES, ModuleContext, Rule
 
 SCHEMA_VERSION = 1
 
 #: the default ("all tiers") rule set: trace-safety lints + the
-#: graftsync thread-context rules.  Sharding rules and the abstract
-#: interpreter join via ``check_paths`` (they need project context).
-DEFAULT_RULES = tuple(ALL_RULES) + tuple(SYNC_RULES)
+#: graftsync thread-context rules + the graftown ownership rules.
+#: Sharding rules and the abstract interpreter join via
+#: ``check_paths`` (they need project context).
+DEFAULT_RULES = tuple(ALL_RULES) + tuple(SYNC_RULES) + tuple(OWN_RULES)
 
 
 @dataclass
@@ -285,6 +288,26 @@ def thread_inventory(paths: Sequence[str]) -> Dict[str, Dict[str, str]]:
         if labels:
             out[_relpath(fp)] = labels
     return out
+
+
+def effect_inventory(paths: Sequence[str]) -> Dict[str, object]:
+    """The graftown ``--effects`` dump: the declarative effect table
+    plus every inferred per-function resource-effect summary under
+    ``paths`` — deterministic across runs, the input to the effect
+    drift test (both directions: a primitive dropped from the table
+    and a new lifecycle helper both show up as a diff)."""
+    files: Dict[str, Dict[str, object]] = {}
+    for fp in iter_python_files(paths):
+        with open(fp, encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=fp)
+        except SyntaxError:
+            continue
+        labels = EffectMap(ModuleIndex(tree)).labels()
+        if labels:
+            files[_relpath(fp)] = labels
+    return {"table": effect_table_dict(), "files": files}
 
 
 def jit_inventory(paths: Sequence[str]) -> List[Dict[str, object]]:

@@ -1120,7 +1120,8 @@ class ServingEngine:
         self.metrics.record_prefix(pos0, seed_len)
         if pos0 <= 0:
             return False     # nothing actually skipped: cold path
-        slot = pool.alloc()
+        now = self._now()    # before alloc: nothing may fail while the
+        slot = pool.alloc()  # slot is held but not yet seated
         try:
             if self.faults is not None:
                 self.faults.check("admit_oom")
@@ -1142,7 +1143,7 @@ class ServingEngine:
             req.state = RequestState.QUEUED
             req.slot = None
             raise
-        req.admit_time = self._now()
+        req.admit_time = now
         req.slot = slot
         req.prefill_pos = pos0
         req.prefix_hit_tokens = pos0
@@ -1170,9 +1171,16 @@ class ServingEngine:
             #                       suffix (or re-queued under pressure)
             T = req.seed_len
             if T > self.prefill_chunk:
+                now = self._now()
                 slot = self.pool.alloc()
-                self.pool.reset_row(slot)
-                req.admit_time = self._now()
+                try:
+                    self.pool.reset_row(slot)
+                except Exception:
+                    # nothing seated yet: hand the slot straight back so
+                    # a row-scrub failure cannot strand it
+                    self.pool.release(slot)
+                    raise
+                req.admit_time = now
                 req.slot = slot
                 req.prefill_pos = 0
                 req.state = RequestState.PREFILLING

@@ -286,9 +286,16 @@ class PagedKVPool(SlotPool):
                 changed = True
             elif self.page_refs[pid] > 1:
                 fork = self.alloc_page()
-                cs = self._jit_copy_page(self.cache["cache_store"],
-                                         jnp.asarray(pid, jnp.int32),
-                                         jnp.asarray(fork, jnp.int32))
+                try:
+                    cs = self._jit_copy_page(self.cache["cache_store"],
+                                             jnp.asarray(pid, jnp.int32),
+                                             jnp.asarray(fork, jnp.int32))
+                except Exception:
+                    # copy dispatch died before the fork was mapped:
+                    # return it to the free list (fresh refcount is 1)
+                    # instead of stranding it until the next reset()
+                    self.unref_page(fork)
+                    raise
                 self.cache = {"cache_store": cs}
                 self.table[slot, p] = fork
                 self.unref_page(pid)

@@ -189,6 +189,7 @@ class ReplicaRouter:
                 self._fail_over(i)
         for req in finished:
             self._tracked.pop(req.request_id, None)
+            self._owner.pop(req.request_id, None)
         if not any(self._alive):
             raise NoLiveReplicaError("all replicas have failed")
         return finished
@@ -254,6 +255,7 @@ class ReplicaRouter:
                 r.finish_reason = FinishReason.ERROR
                 r.finish_time = self._now()
                 self._tracked.pop(r.request_id, None)
+                self._owner.pop(r.request_id, None)
         # sticky sessions homed on the corpse re-route on next submit
         for key, idx in list(self._session.items()):
             if idx == dead:
@@ -283,6 +285,7 @@ class ReplicaRouter:
         req = self.replicas[idx].cancel(request_id)
         if req is not None:
             self._tracked.pop(request_id, None)
+            self._owner.pop(request_id, None)
         return req
 
     def end_warmup(self) -> None:
@@ -293,6 +296,14 @@ class ReplicaRouter:
         """Cross-replica audit: every ALIVE replica's slot/queue/pool
         bookkeeping must hold (dead replicas are tombstones — their
         state was deliberately stripped by failover)."""
+        # ownership entries may not outlive tracking: _owner and
+        # _tracked are populated and retired together, so a stale
+        # _owner key is an unbounded host-side leak
+        stale = set(self._owner) - set(self._tracked)
+        if stale:
+            raise AssertionError(
+                f"router _owner map holds {len(stale)} request id(s) "
+                f"no longer tracked: {sorted(stale)[:5]}")
         for i in self.alive_replicas:
             self.replicas[i].check_invariants()
 

@@ -10,7 +10,7 @@ import subprocess
 import sys
 
 import deepspeed_tpu
-from deepspeed_tpu.analysis import (ALL_RULES, CHECK_RULE_IDS,
+from deepspeed_tpu.analysis import (ALL_RULES, CHECK_RULE_IDS, OWN_RULES,
                                     SHARDING_RULES, SYNC_RULE_IDS,
                                     SYNC_RULES, analyze_paths,
                                     check_paths, iter_python_files)
@@ -79,6 +79,9 @@ def test_gate_runs_every_rule():
         "blocking-call-in-coroutine", "cross-thread-engine-access",
         "unsafe-future-resolution", "await-while-holding-lock",
         "unguarded-shared-write"}
+    assert {r.id for r in OWN_RULES} == {
+        "leak-on-exception-path", "double-release", "use-after-release",
+        "unbalanced-refcount", "missing-rollback"}
     assert {r.id for r in SHARDING_RULES} == {
         "mesh-axis-unknown", "shard-indivisible",
         "donation-alias-mismatch", "placement-mix"}
@@ -107,6 +110,25 @@ def test_sync_gate_zero_unsuppressed_errors():
         if f.suppressed:
             assert f.rule in SYNC_RULE_IDS, f.format_human()
             assert f.suppress_reason, f.format_human()
+
+
+def test_own_gate_zero_unsuppressed_errors():
+    """The graftown tier alone over its gated surface (all of serving/,
+    where every slot/page/future lifecycle lives) holds at zero
+    unsuppressed errors with NO baseline and NO pragmas — the tier was
+    triaged by fixing code, not by grandfathering findings."""
+    surface = [os.path.join(REPO, "deepspeed_tpu", "serving")]
+    rep = analyze_paths(surface, rules=OWN_RULES)
+    offenders = [f.format_human() for f in rep.findings
+                 if f.counts_as_error]
+    assert rep.errors == 0, (
+        "graftown gate broken — fix the finding or add a reasoned "
+        "pragma:\n" + "\n".join(offenders))
+    assert rep.warnings == 0, [f.format_human() for f in rep.findings
+                               if f.severity == "warning"]
+    assert rep.suppressed == 0 and rep.baselined == 0, (
+        "the own tier holds with no suppressions at all: "
+        + "\n".join(f.format_human() for f in rep.findings))
 
 
 def test_check_tier_gate_zero_unsuppressed_errors():

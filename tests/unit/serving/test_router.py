@@ -75,10 +75,33 @@ def test_router_ids_globally_unique(stack):
     reqs = [router.submit(p, max_new_tokens=2) for p in _prompts(9, rng)]
     ids = [r.request_id for r in reqs]
     assert len(set(ids)) == len(ids)
-    router.run_until_drained(max_steps=400)
     for r in reqs:
         owner = router._owner[r.request_id]
         assert r.request_id // ID_STRIDE == owner
+    router.run_until_drained(max_steps=400)
+
+
+def test_owner_map_retired_with_tracking(stack):
+    """Router bookkeeping may not outlive a request: finishing,
+    cancelling and unplaceable-failover all retire the ``_owner`` entry
+    alongside ``_tracked`` (regression: ``_owner`` kept every id ever
+    routed, an unbounded host-side leak graftown's
+    leak-on-exception-path family is built to catch)."""
+    _, _, engine = stack
+    rng = np.random.default_rng(7)
+    router = ReplicaRouter([_mk(engine), _mk(engine)])
+    reqs = [router.submit(p, max_new_tokens=3) for p in _prompts(6, rng)]
+    assert len(router._owner) == len(reqs)
+
+    victim = reqs[-1]
+    assert router.cancel(victim.request_id) is not None
+    assert victim.request_id not in router._owner
+    assert victim.request_id not in router._tracked
+
+    router.run_until_drained(max_steps=400)
+    assert router._tracked == {}
+    assert router._owner == {}
+    router.check_invariants()
 
 
 def test_failover_requeues_to_sibling_bitwise(stack):
