@@ -261,6 +261,8 @@ WATCHED_MODELS = {
     "_admit_jit": lambda args, kw, env: Tree(COMMITTED, "pool"),
     "_admit_rows_jit": lambda args, kw, env: Tree(COMMITTED, "pool"),
     "_jit_copy_page": lambda args, kw, env: Tree(COMMITTED, "pool"),
+    "_jit_gather_pages": lambda args, kw, env: Tree(COMMITTED, "pool"),
+    "_jit_scatter_pages": lambda args, kw, env: Tree(COMMITTED, "pool"),
     "_paged_decode_jit": lambda args, kw, env: Tup(
         [_logits(_batch_of(args[2]), env), Tree(COMMITTED, "pool")]),
     "_paged_chunk_jit": lambda args, kw, env: Tup(
@@ -313,6 +315,11 @@ SKIP_MODELS = {
     ("PagedKVPool", "ref_page"): lambda s, a, kw: Scalar(None),
     ("PagedKVPool", "unref_page"): lambda s, a, kw: Scalar(None),
     ("PagedKVPool", "_sync_table"): lambda s, a, kw: Scalar(None),
+    # the wire hop of a cross-pool transfer: device_put of every block
+    # leaf onto the pool's committed placement — the scatter's block
+    # operand is COMMITTED by construction, which is the whole point
+    ("PagedKVPool", "_land_block"): lambda s, a, kw: Tree(COMMITTED,
+                                                          "pool"),
     ("PagedKVPool", "bind_engine"): lambda s, a, kw: Scalar(None),
     ("PagedKVPool", "cache_prefix"): lambda s, a, kw: Scalar(
         Unbounded("cached pages")),
@@ -1281,6 +1288,8 @@ def _pool_obj(env: dict, engine: Obj) -> Obj:
             "cow_copies": Scalar(0),
             "page_evictions": Scalar(0),
             "_jit_copy_page": Obj("jit"),
+            "_jit_gather_pages": Obj("jit"),
+            "_jit_scatter_pages": Obj("jit"),
             "_paged_decode_jit": Obj("jit"),
             "_paged_verify_jit": Obj("jit"),
             "_paged_chunk_jit": Obj("jit"),
@@ -1465,6 +1474,16 @@ def run_drivers(interp: Interp) -> None:
             "start": Scalar(IntRange(0, cap, "start")),
             "end": Scalar(IntRange(0, cap, "end")),
             "sync": Scalar(True)})
+
+        # 7. cross-pool page transfer (the disaggregated prefill->decode
+        #    handoff): id vectors are always sentinel-padded to
+        #    pages_per_slot, so ONE signature covers every transfer
+        #    (also pre-warmed by bind_engine with an all-sentinel copy)
+        pps = int(env["pages_per_slot"])
+        call(pool, "_dispatch_transfer", {
+            "src_pool": _pool_obj(env, srv.attrs["engine"]),
+            "src_vec": Arr((Known(pps),), "int32", HOST),
+            "dst_vec": Arr((Known(pps),), "int32", HOST)})
 
 
 def default_check_envs() -> List[dict]:

@@ -77,10 +77,13 @@ EFFECT_TABLE: Dict[str, Dict[str, Tuple[str, ...]]] = {
                 "cache_prefix", "run_prefill_chunk"),
     },
     "page": {
-        "acquire": ("alloc_page",),
+        # import_pages: the cross-pool transfer primitive — destination
+        # pages come back refcount-1 OWNED BY THE CALLER (exactly like
+        # alloc_page) until seat_pages moves them into a slot table
+        "acquire": ("alloc_page", "import_pages"),
         "ref": ("ref_page",),
-        "unref": ("unref_page",),
-        "transfer": ("insert", "map_prefix", "seat_prefix"),
+        "unref": ("unref_page", "unref_pages"),
+        "transfer": ("insert", "map_prefix", "seat_pages", "seat_prefix"),
     },
     "seat": {
         "acquire": ("grant",),
@@ -261,16 +264,24 @@ def _own_stmts(fn: ast.AST) -> Iterator[ast.stmt]:
     yield from rec(getattr(fn, "body", []))
 
 
-def _expr_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+def _expr_calls(stmt: ast.stmt) -> List[ast.Call]:
     """Calls owned by ``stmt`` (not those of nested statements), in walk
-    order; descends into comprehensions but not lambdas."""
+    order; descends into comprehensions but not lambdas.  Memoised on
+    the statement node — the path walk and the raise oracle both ask
+    for the same statement's calls many times over."""
+    cached = getattr(stmt, "_own_expr_calls", None)
+    if cached is not None:
+        return cached
     from .dataflow import stmt_exprs
+    out: List[ast.Call] = []
     for e in stmt_exprs(stmt):
         for n in ast.walk(e):
             if isinstance(n, ast.Lambda):
                 continue
             if isinstance(n, ast.Call):
-                yield n
+                out.append(n)
+    stmt._own_expr_calls = out
+    return out
 
 
 class EffectMap:

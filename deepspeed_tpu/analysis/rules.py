@@ -272,6 +272,7 @@ DONATION_FALLBACK: Dict[str, Tuple[int, ...]] = {
     "_jit_prefill_chunk": (1,),
     "_jit_decode_scan": (1,),
     "_jit_copy_page": (0,),
+    "_jit_scatter_pages": (0,),
     "_admit_jit": (0,),
     "_admit_rows_jit": (0,),
     "_paged_decode_jit": (1,),

@@ -109,7 +109,8 @@ _WATCHED_POOL_JITS = ("_admit_jit", "_admit_rows_jit",
                       "_paged_decode_jit", "_paged_verify_jit",
                       "_paged_decode_kernel_jit",
                       "_paged_verify_kernel_jit",
-                      "_paged_chunk_jit", "_jit_copy_page")
+                      "_paged_chunk_jit", "_jit_copy_page",
+                      "_jit_gather_pages", "_jit_scatter_pages")
 _WATCHED_SERVING_JITS = ("_jit_finite", "_jit_cur_scatter", "_jit_spec_cur")
 # the model drafter jits its own last-token argmax (lazily, on the
 # first propose); unwatched it was the one serving-side jit that could
@@ -157,7 +158,8 @@ class ServingEngine:
                  dump_dir: Optional[str] = None,
                  priority: Any = None,
                  clock: Optional[Any] = None,
-                 overlap: bool = False):
+                 overlap: bool = False,
+                 role: str = "both"):
         self.engine = engine
         # ONE monotonic clock for every time-dependent decision —
         # deadline stamps, queue expiry, SLO latencies, degradation
@@ -436,6 +438,26 @@ class ServingEngine:
                 axis=1)[:, 0].astype(jnp.int32),
             out_shardings=self._cur_sharding)
         self._overlap = bool(overlap)
+        # -- disaggregated prefill/decode role (ISSUE 19) --------------
+        # "both" is the classic colocated engine. "prefill" runs
+        # admission/chunked prefill only and parks each request once its
+        # pages are full and its first token sampled (see
+        # pending_handoffs); "decode" additionally accepts adopted
+        # requests whose prefill ran elsewhere. Roles change NO jit
+        # signature — every program is built and warmed identically, a
+        # prefill engine simply never dispatches the decode ones.
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, "
+                             f"got {role!r}")
+        if role != "both" and not self._paged:
+            raise ValueError("prefill/decode roles require paged_kv: "
+                             "pages are the cross-replica handoff unit")
+        self.role = role
+        # prefill role: seated RUNNING requests whose prompts are fully
+        # paged in and first token sampled, awaiting transfer to a
+        # decode replica (they hold their slot+pages until adopted)
+        self._handoff_ready: Optional[List[Request]] = \
+            [] if role == "prefill" else None
         # pre-warm every reachable cur-scatter width NOW, before the
         # watchdog attaches below: singles scatter (1,) and batched
         # admissions scatter the power-of-two group buckets, a bounded
@@ -525,6 +547,10 @@ class ServingEngine:
             "use_prefix": bool(self._use_prefix),
             "stall_free": bool(self._stall_free),
             "overlap": bool(self._overlap),
+            # role never moves a traced shape (same warmups, same
+            # programs; a prefill engine just skips the decode
+            # dispatch) — recorded for arm attribution like the mesh
+            "role": str(self.role),
             # mesh shape the caches/params were committed under. The
             # jitted entries keep their signatures across mesh shapes
             # (the tentpole invariant — only in/out shardings move), so
@@ -1378,6 +1404,15 @@ class ServingEngine:
             # clamp-overwrite the last column on the next decode write
             req.finish_reason = FinishReason.LENGTH_CAP
         else:
+            if self._handoff_ready is not None and \
+                    req.state is RequestState.RUNNING:
+                # prefill role: pages full, first token sampled — the
+                # request now belongs to a decode replica. It stays
+                # seated (slot + page references held) until the router
+                # transfers it or a rollback path retires it.
+                self._handoff_ready.append(req)
+                self.timelines.record(req.request_id, "handoff_ready",
+                                      slot=req.slot)
             return
         req.state = RequestState.FINISHED
         req.finish_time = self._now()
@@ -1415,6 +1450,119 @@ class ServingEngine:
                               spec_drafted=req.spec_drafted,
                               spec_accepted=req.spec_accepted)
 
+    # -- disaggregated prefill/decode handoff (ISSUE 19) ---------------
+    def pending_handoffs(self) -> List[Request]:
+        """Prefill role: the seated RUNNING requests whose prefill is
+        complete and first token sampled, ready for a decode replica.
+        Non-destructive — a successful :meth:`adopt` on the destination
+        followed by :meth:`finish_handoff` here removes an entry, so a
+        request the router cannot place this step is simply retried."""
+        return list(self._handoff_ready or ())
+
+    def adopt(self, req: Request, src: "ServingEngine") -> dict:
+        """Seat a request whose prefill ran on ANOTHER replica: copy its
+        live pages across pools (one fixed-shape jitted transfer — see
+        :meth:`PagedKVPool.import_pages`), seat them, and resume decode
+        at the source's exact position. Pages the local prefix trie
+        already holds for the request's prompt are mapped for free (a
+        refcount bump) and only the uncached tail is moved — the
+        prefix-affine dispatch payoff. The transferred pages are the
+        same bits the source produced and the first token was already
+        sampled from them, so greedy output is bitwise identical to a
+        colocated run.
+
+        On any failure nothing stays seated here (allocated pages are
+        unwound on both pools) and the exception propagates — the
+        router re-homes the request through the failover scrub.
+        Returns transfer accounting: ``{"pages", "hit_pages", "bytes",
+        "seconds"}``."""
+        if self.role == "prefill":
+            raise ValueError("adopt() needs a decode-capable replica "
+                             "(role 'decode' or 'both')")
+        if not self._paged or not getattr(src, "_paged", False):
+            raise ValueError("adopt() requires paged KV on both replicas")
+        if req.state is not RequestState.RUNNING or req.slot is None:
+            raise ValueError(f"adopt() needs a seated RUNNING request; "
+                             f"req {req.request_id} is {req.state.value}")
+        pool, spool = self.pool, src.pool
+        src_slot = req.slot
+        seed = req.seed_tokens
+        pos = int(spool.starts[src_slot])
+        n_live = -(-pos // spool.page_size)
+        src_pages = [int(p) for p in spool.table[src_slot, :n_live]]
+        t0 = self._now()
+        slot = pool.alloc()
+        hit_pages: List[int] = []
+        try:
+            pool.reset_row(slot)
+            if self._use_prefix:
+                # local trie hit: map the cached prefix pages in place
+                # of transferring them (their bits are identical — they
+                # came off an earlier transfer or colocated prefill)
+                hit_pages = pool.prefix.match(seed)[:n_live]
+            if hit_pages:
+                pool.map_prefix(slot, hit_pages, sync=False)
+            dst_pages = pool.import_pages(spool, src_pages[len(hit_pages):])
+        except Exception:
+            # import_pages already unwound its own failure, so only
+            # the slot (and any mapped prefix pages) needs releasing
+            pool.release(slot)
+            raise
+        try:
+            pool.seat_pages(slot, dst_pages, pos,
+                            first_entry=len(hit_pages))
+        except Exception:
+            # seat_pages is atomic: on failure it took NONE of the
+            # batch, so the whole import is ours to hand back
+            pool.unref_pages(dst_pages)
+            pool.release(slot)
+            raise
+        now = self._now()
+        req.slot = slot
+        req.last_admit_step = self.step_id
+        if req.admit_time is None:
+            req.admit_time = now
+        self._slot_req[slot] = req
+        # current-token twin: the source's last sampled token resumes
+        # the decode loop here (width-1 scatter — a pre-warmed program)
+        tok = int(req.output_tokens[-1])
+        self._current[slot] = tok
+        self._cur_dev = self._jit_cur_scatter(
+            self._cur_dev,
+            self._cur_commit(np.asarray([tok], np.int32)),
+            jnp.asarray([slot]))
+        if self._use_prefix:
+            # publish the adopted prompt's full pages into THIS pool's
+            # trie: the next same-prefix handoff routed here skips the
+            # transfer for those pages entirely
+            pool.cache_prefix(slot, seed)
+        self.timelines.record(req.request_id, "adopted", slot=slot,
+                              pages=len(dst_pages),
+                              hit_pages=len(hit_pages))
+        self.tracer.flow("s", "req", req.request_id)
+        return {"pages": len(dst_pages), "hit_pages": len(hit_pages),
+                "bytes": len(dst_pages) * pool.page_nbytes,
+                "seconds": now - t0}
+
+    def finish_handoff(self, req: Request, slot: int) -> None:
+        """Prefill role: release the source seat AFTER a decode replica
+        adopted the request. ``slot`` is the source slot (``req.slot``
+        already points at the destination). The slot and its page
+        references go back through the standard rollback — trie-cached
+        prompt pages stay warm for the next same-prefix prompt — and
+        the request's timeline HERE closes with a terminal hand-off
+        event (it finishes on the adopting replica's timeline)."""
+        if self._slot_req.get(slot) is not req:
+            raise ValueError(f"finish_handoff: slot {slot} does not seat "
+                             f"req {req.request_id}")
+        del self._slot_req[slot]
+        self.pool.release(slot)
+        if self._handoff_ready:
+            self._handoff_ready[:] = [r for r in self._handoff_ready
+                                      if r is not req]
+        self.timelines.record(req.request_id, "handed_off", terminal=True,
+                              slot=slot)
+
     # -- resilience: eviction, deadlines, preemption -------------------
     def _evict_slot(self, req: Request) -> None:
         """Reclaim a seated request's slot through the rollback path:
@@ -1430,6 +1578,11 @@ class ServingEngine:
         # elementwise-compare their numpy prompts
         self._prefill_queue[:] = [r for r in self._prefill_queue
                                   if r is not req]
+        if self._handoff_ready:
+            # a parked handoff that retires (deadline/cancel/preempt)
+            # before any decode replica adopts it leaves the launchpad
+            self._handoff_ready[:] = [r for r in self._handoff_ready
+                                      if r is not req]
 
     def _expire_deadlines(self, finished: List[Request]) -> None:
         """Retire every request whose deadline has passed: queued ones
@@ -1631,7 +1784,8 @@ class ServingEngine:
                         page_budget=page_budget, page_cost=page_cost)
             try:
                 decoded = False
-                if self._overlap and self._running_count():
+                if self._overlap and self._running_count() \
+                        and self.role != "prefill":
                     # pipelined order: the decode (or draft+verify) for
                     # the slots ALREADY running is dispatched first, so
                     # admission/prefill host bookkeeping below overlaps
@@ -1656,7 +1810,8 @@ class ServingEngine:
                     # state has moved yet
                     self.faults.maybe_sleep("slow_dispatch")
                     self.faults.check("step_host_error")
-                if not decoded and self._running_count():
+                if not decoded and self._running_count() \
+                        and self.role != "prefill":
                     t0 = self._now()
                     if self._spec is not None:
                         self._spec_decode_step(finished, t0)
@@ -1995,6 +2150,8 @@ class ServingEngine:
             self.timelines.record(req.request_id, "failed", terminal=True,
                                   reason=FinishReason.ERROR.value)
         self._slot_req.clear()
+        if self._handoff_ready:
+            self._handoff_ready.clear()  # every member was seated -> FAILED
         self._current[:] = 0
         # drop queued-but-unfetched host bookkeeping: its device arrays
         # belong to the aborted step's state, and its requests are now
@@ -2111,6 +2268,14 @@ class ServingEngine:
                 np.any(self.pool.starts > self.pool.capacity):
             errors.append(f"cache starts out of [0, {self.pool.capacity}]: "
                           f"{self.pool.starts.tolist()}")
+        for r in (self._handoff_ready or ()):
+            # a parked handoff must still be a live seat HERE — anything
+            # else means a retire/transfer path forgot to purge it
+            if r.state is not RequestState.RUNNING or r.slot is None \
+                    or self._slot_req.get(r.slot) is not r:
+                errors.append(f"handoff-ready req {r.request_id} not "
+                              f"seated RUNNING (state={r.state.value}, "
+                              f"slot={r.slot})")
         if errors:
             err = InvariantViolation(errors)
             self._post_mortem("invariant_violation", err,

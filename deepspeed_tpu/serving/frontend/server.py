@@ -17,9 +17,13 @@ points (DeepSpeed-MII's REST/gRPC shell around the inference engine):
 * ``GET /healthz`` — load state from the :class:`LoadStateMachine`
   (``healthy``/``pressured``/``overloaded``), queue/slot occupancy and
   per-class queue depths; 503 + ``Retry-After`` when overloaded so
-  upstream balancers back off before the engine has to shed.
+  upstream balancers back off before the engine has to shed. When the
+  bridge fronts a :class:`ReplicaRouter` the payload gains a ``fleet``
+  object (per-role replica counts, transfers in flight, last scale
+  event) and the load state aggregates over prefill-capable replicas.
 * ``GET /metrics`` — the existing Prometheus exposition
-  (``MetricsRegistry.to_prometheus``).
+  (``MetricsRegistry.to_prometheus``); a router adds its fleet gauges
+  (``router_fleet_size``, ``router_transfers_total``, ...).
 
 Every engine interaction goes through the :class:`AsyncEngineBridge`
 (one dedicated step thread; see ``bridge.py``) — handlers never touch
@@ -298,24 +302,36 @@ class ServingFrontend:
 
     async def _healthz(self, writer: asyncio.StreamWriter) -> None:
         def probe(srv: Any) -> Dict[str, Any]:
+            # duck-typed over both a single ServingEngine and a
+            # ReplicaRouter fleet (which has no scheduler/pool of its
+            # own but aggregates the same numbers)
             load = getattr(srv, "_load", None)
-            state = load.state.name.lower() if load is not None \
-                else "healthy"
+            if hasattr(load, "state"):
+                state = load.state.name.lower()
+            else:
+                state = getattr(srv, "health_state", "healthy")
+            sched = getattr(srv, "scheduler", None)
+            pool = getattr(srv, "pool", None)
             out = {
                 "state": state,
-                "queue_depth": srv.scheduler.pending,
+                "queue_depth": sched.pending if sched is not None
+                else srv.pending,
                 "live_slots": srv.live_count,
-                "num_slots": srv.pool.num_slots,
+                "num_slots": pool.num_slots if pool is not None
+                else srv.num_slots,
                 "step_id": srv.step_id,
             }
             deg = getattr(srv, "_degradation", None)
             if deg is not None:
                 out["retry_after_s"] = deg.retry_after_s
-            if hasattr(srv.scheduler, "class_depths"):
-                out["class_queue_depths"] = srv.scheduler.class_depths()
-            if srv.slo is not None:
-                out["class_alerts"] = dict(srv.slo.class_alerts)
-                out["goodput"] = srv.slo.goodput()
+            if sched is not None and hasattr(sched, "class_depths"):
+                out["class_queue_depths"] = sched.class_depths()
+            slo = getattr(srv, "slo", None)
+            if slo is not None:
+                out["class_alerts"] = dict(slo.class_alerts)
+                out["goodput"] = slo.goodput()
+            if hasattr(srv, "fleet_topology"):
+                out["fleet"] = srv.fleet_topology()
             return out
 
         info = await self.bridge.call(probe)

@@ -140,6 +140,14 @@ class PagedKVPool(SlotPool):
         self._paged_verify_kernel_jit = None
         self._jit_copy_page = jax.jit(self._copy_page_body,
                                       donate_argnums=(0,))
+        # the cross-pool transfer is two programs, not one: replicas
+        # live on DISJOINT meshes, and no single jit can span two
+        # device sets — the source gathers the page batch on ITS
+        # devices, the block hops meshes via an explicit device_put
+        # (the "wire"), and the destination scatters on its own
+        self._jit_gather_pages = jax.jit(self._gather_pages_body)
+        self._jit_scatter_pages = jax.jit(self._scatter_pages_body,
+                                          donate_argnums=(0,))
         self._admit_rows_jit = jax.jit(self._paged_admit_rows,
                                        donate_argnums=(0,))
 
@@ -358,6 +366,142 @@ class PagedKVPool(SlotPool):
         return self.prefix.insert(tokens, pages, self)
 
     # ------------------------------------------------------------------
+    # cross-pool page transfer (disaggregated prefill -> decode handoff)
+    # ------------------------------------------------------------------
+    @property
+    def page_nbytes(self) -> int:
+        """Bytes one page occupies across every cache leaf (what a
+        cross-pool transfer moves per page)."""
+        cs = self.cache["cache_store"]
+        return sum(int(np.prod(cs[k].shape)) * cs[k].dtype.itemsize
+                   // self.num_pages
+                   for k in ("k", "v", "k_scale", "v_scale") if k in cs)
+
+    def import_pages(self, src_pool: "PagedKVPool",
+                     src_page_ids: Sequence[int]) -> List[int]:
+        """Copy ``src_page_ids`` out of ANOTHER pool's storage into
+        freshly allocated pages here — the device half of a
+        disaggregated prefill->decode handoff. One fixed-shape jitted
+        gather + one donated scatter per call (id vectors sentinel-
+        padded to ``pages_per_slot``, the block hopping meshes between
+        them), so every transfer — any page count, any replica pair —
+        reuses the same two compiled programs.
+
+        Ownership contract: the returned destination pages carry
+        refcount 1 OWNED BY THE CALLER until :meth:`seat_pages` maps
+        them into a slot's table. The source pool's references are
+        untouched — the source slot's ``release()`` drops them exactly
+        once, after the copy. On ANY failure (allocation or copy
+        dispatch) every destination page allocated so far is unref'd
+        before the exception propagates (the :meth:`ensure_writable`
+        unwind template), so a mid-transfer death leaks nothing on
+        either pool."""
+        ids = [int(p) for p in src_page_ids]
+        if len(ids) > self.pages_per_slot:
+            raise ValueError(
+                f"import_pages: {len(ids)} pages exceed pages_per_slot "
+                f"({self.pages_per_slot}) — a transfer moves at most one "
+                f"slot's table per call")
+        if (src_pool.page_size != self.page_size
+                or src_pool.num_pages != self.num_pages
+                or src_pool.pages_per_slot != self.pages_per_slot):
+            raise ValueError(
+                f"import_pages needs identical page geometry on both "
+                f"pools (one compiled transfer program); got src="
+                f"{src_pool.num_pages}x{src_pool.page_size} vs dst="
+                f"{self.num_pages}x{self.page_size}")
+        for pid in ids:
+            if pid in src_pool._free_page_set \
+                    or src_pool.page_refs[pid] <= 0:
+                raise ValueError(f"import_pages: source page {pid} is "
+                                 f"free (nothing to copy)")
+        dst: List[int] = []
+        try:
+            for _ in ids:
+                dst.append(self.alloc_page())
+            src_vec = np.full((self.pages_per_slot,),
+                              src_pool.num_pages, np.int32)
+            dst_vec = np.full((self.pages_per_slot,),
+                              self.num_pages, np.int32)
+            src_vec[:len(ids)] = ids
+            dst_vec[:len(dst)] = dst
+            cs = self._dispatch_transfer(src_pool, src_vec, dst_vec)
+        except Exception:
+            # unwind: pages allocated for a transfer that never landed
+            # go straight back to the free list (fresh refcount is 1)
+            self.unref_pages(dst)
+            raise
+        self.cache = {"cache_store": cs}
+        self._inc("paging/pages_imported", len(dst))
+        return dst
+
+    def unref_pages(self, page_ids: Sequence[int]) -> None:
+        """Drop one reference on each page — the bulk unwind of an
+        :meth:`import_pages` batch whose seating failed (the caller
+        still owns every page in the batch; :meth:`seat_pages` is
+        atomic, so failure means NONE were taken)."""
+        for pid in page_ids:
+            self.unref_page(int(pid))
+
+    def _land_block(self, block: dict) -> dict:
+        """Move a gathered page block onto THIS pool's devices — the
+        wire hop of a disaggregated transfer (replicas live on disjoint
+        meshes; a same-mesh handoff makes this a no-op). Placement goes
+        through :meth:`_place_leaf` so the block the scatter sees here
+        is committed exactly like the block its bind-time precompile
+        saw — the difference between zero and one executable."""
+        return {k: self._place_leaf(k, v) for k, v in block.items()}
+
+    def _dispatch_transfer(self, src_pool: "PagedKVPool",
+                           src_vec, dst_vec):
+        """The traced dispatch of a cross-pool transfer: id vectors
+        arrive already sentinel-padded to ``pages_per_slot``, so every
+        call replays the SAME two compiled programs — the source pool's
+        gather, then (after the block hops onto this pool's devices)
+        this pool's donated scatter (graftcheck drives exactly this
+        method)."""
+        block = src_pool._jit_gather_pages(
+            src_pool.cache["cache_store"], jnp.asarray(src_vec))
+        block = self._land_block(block)
+        return self._jit_scatter_pages(
+            self.cache["cache_store"], block, jnp.asarray(dst_vec))
+
+    def seat_pages(self, slot: int, page_ids: Sequence[int],
+                   prefill_pos: int, first_entry: int = 0) -> None:
+        """Seat imported pages into ``slot`` at ``prefill_pos``: the
+        slot's table TAKES the caller's :meth:`import_pages` references
+        (no refcount bump — ownership transfers to the table) and
+        index+table republish in one rebind (the :meth:`seat_prefix`
+        idiom). ``first_entry`` offsets the table entries — a
+        prefix-affine adopt maps trie-hit pages at ``[0, first_entry)``
+        via :meth:`map_prefix` and seats only the transferred tail
+        here. The decode loop resumes exactly where the source
+        replica's prefill stopped."""
+        ids = [int(p) for p in page_ids]
+        need = -(-int(prefill_pos) // self.page_size)
+        if first_entry + len(ids) < need:
+            raise ValueError(
+                f"seat_pages: {first_entry}+{len(ids)} pages cannot back "
+                f"prefill_pos={prefill_pos} (live region needs {need})")
+        # validate EVERYTHING before the first table write: seating is
+        # atomic, so a caller's unwind never has to ask which pages a
+        # half-failed seat already took
+        for i, pid in enumerate(ids):
+            if self.table[slot, first_entry + i] != self.num_pages:
+                raise RuntimeError(f"seat_pages over occupied entry "
+                                   f"({slot}, {first_entry + i})")
+            if pid in self._free_page_set or self.page_refs[pid] <= 0:
+                raise RuntimeError(f"seat_pages: page {pid} is free "
+                                   f"(import its data first)")
+        for i, pid in enumerate(ids):
+            self.table[slot, first_entry + i] = pid
+        self.starts[slot] = int(prefill_pos)
+        cs = dict(self.cache["cache_store"])
+        cs["index"] = self._index_from_mirror()
+        cs["table"] = self._table_from_mirror()
+        self.cache = {"cache_store": cs}
+
+    # ------------------------------------------------------------------
     # jitted gather/scatter programs
     # ------------------------------------------------------------------
     @staticmethod
@@ -373,6 +517,34 @@ class PagedKVPool(SlotPool):
             page = jax.lax.dynamic_slice_in_dim(leaf, src, 1, 1)
             out[key] = jax.lax.dynamic_update_slice_in_dim(leaf, page,
                                                            dst, 1)
+        return out
+
+    @staticmethod
+    def _gather_pages_body(src_cs: dict, src_ids):
+        """Source half of a cross-pool transfer (the prefill->decode
+        handoff): pull the sentinel-padded page batch out of the source
+        pool's storage as one fixed-width (``pages_per_slot``) block
+        per leaf — the transfer's wire format. A sentinel id clip-reads
+        an arbitrary real page; its paired sentinel destination entry
+        drops the write on the other side, so ONE compile covers every
+        transfer size — the same trick the admission scatter uses.
+        Runs on the SOURCE pool's devices."""
+        return {key: jnp.take(src_cs[key], src_ids, axis=1, mode="clip")
+                for key in ("k", "v", "k_scale", "v_scale")
+                if key in src_cs}
+
+    @staticmethod
+    def _scatter_pages_body(dst_cs: dict, block: dict, dst_ids):
+        """Destination half: seat the gathered block at ``dst_ids``
+        (sentinel entries drop), all layers in one donated in-place
+        program. Runs on the DESTINATION pool's devices — the block
+        arrived via :meth:`_land_block`."""
+        out = dict(dst_cs)
+        for key in ("k", "v", "k_scale", "v_scale"):
+            if key not in dst_cs:
+                continue
+            out[key] = dst_cs[key].at[:, dst_ids].set(
+                block[key].astype(dst_cs[key].dtype), mode="drop")
         return out
 
     def _scatter_cols(self, pool: dict, dense: dict, tables, positions):
@@ -565,6 +737,20 @@ class PagedKVPool(SlotPool):
         zero = jnp.asarray(0, jnp.int32)
         self.cache = {"cache_store": self._jit_copy_page(
             self.cache["cache_store"], zero, zero)}
+        # same treatment for both halves of the cross-pool transfer: a
+        # decode-role replica sees its first page import whenever the
+        # router's first handoff lands — typically long after warmup
+        # traffic ends — and a prefill-role replica's gather fires at
+        # the same moment from the other side. All-sentinel id vectors
+        # make the pair a no-op (the clip-gather reads garbage, every
+        # scatter write drops); the block rides _land_block so its
+        # committed placement here matches what a real transfer ships.
+        sent_ids = jax.device_put(jnp.full((self.pages_per_slot,),
+                                           self.num_pages, jnp.int32))
+        block = self._land_block(self._jit_gather_pages(
+            self.cache["cache_store"], sent_ids))
+        self.cache = {"cache_store": self._jit_scatter_pages(
+            self.cache["cache_store"], block, sent_ids)}
 
     # ------------------------------------------------------------------
     # jitted entry points (the serving engine dispatches here when paged)

@@ -39,6 +39,29 @@ dead replica die with it; siblings' invariants stay clean.
 Request ids stay globally unique across replicas: replica ``i``'s
 engine counter is offset to ``i * ID_STRIDE`` at construction, so a
 router-issued id names one request no matter which replica seated it.
+
+Disaggregated prefill/decode (the DistServe/Splitwise split): replicas
+may carry a ``role`` — ``"both"`` (the classic colocated engine),
+``"prefill"`` (chunked admission only; finished requests park in
+``pending_handoffs()``), or ``"decode"``. The router becomes the
+topology controller: submissions route to prefill-capable replicas
+(least-loaded), and after every fleet step the router drains each
+prefill replica's parked handoffs — copying the request's live KV pages
+across pools with ``PagedKVPool.import_pages`` (one fixed-shape jitted
+program) and seating them on a decode replica chosen sticky-session
+first, then by a SHARED FIRST-PAGE INDEX over the whole decode pool's
+prefix tries (global prefix affinity: the handoff lands where the
+prompt's first page is already cached, and the transfer skips every
+trie-hit page), then least-loaded. Transfers are synchronous within the
+drain — ``transfers_in_flight`` must read zero at every step boundary
+(audited by :meth:`check_invariants`).
+
+The fleet is ELASTIC: :meth:`add_replica` / :meth:`retire_replica`
+reshape it at runtime (retirement drains through the same failover
+scrub — greedy output is bitwise identical to never having moved), and
+:meth:`maybe_autoscale` drives both from the PR 8 burn-rate signals: a
+role whose replicas sustain a ``page`` alert spawns a sibling, a role
+idling with spare replicas retires one.
 """
 
 from __future__ import annotations
@@ -47,6 +70,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..telemetry.registry import MetricsRegistry
 from .engine import ServingEngine
 from .request import FinishReason, Request, RequestState
 
@@ -72,12 +96,17 @@ class ReplicaRouter:
     """
 
     def __init__(self, replicas: Sequence[ServingEngine],
-                 affinity: bool = True):
+                 affinity: bool = True,
+                 spawner: Optional[Any] = None,
+                 scale_patience: int = 3):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         self.replicas: List[ServingEngine] = list(replicas)
         self.affinity = bool(affinity)
         self._alive: List[bool] = [True] * len(self.replicas)
+        self.roles: List[str] = [getattr(r, "role", "both")
+                                 for r in self.replicas]
+        self._check_role_coverage(self.roles)
         for i, rep in enumerate(self.replicas):
             # offset, don't overwrite: a replica with prior traffic keeps
             # its issued ids unique within its own stripe
@@ -89,6 +118,57 @@ class ReplicaRouter:
         self.affinity_hits = 0
         self.spills = 0          # admissions that fell through to a sibling
         self.failovers = 0       # requests re-homed off a dead replica
+        # -- disaggregation / elasticity (ISSUE 19) --------------------
+        self.transfers = 0       # completed prefill->decode handoffs
+        self.transfer_bytes = 0
+        self.prefix_routed = 0   # handoffs placed via the shared
+        #                          first-page index (global prefix hit)
+        self.transfer_pages_saved = 0  # pages a destination trie hit
+        #                          kept off the wire (adopt hit_pages)
+        self._transfers_in_flight = 0  # nonzero ONLY inside one drain
+        self._req_session: Dict[int, str] = {}   # rid -> session key
+        self._decode_session: Dict[str, int] = {}  # session -> decode idx
+        self.spawner = spawner   # role -> ServingEngine factory (autoscale)
+        self.scale_patience = int(scale_patience)
+        self._hot_streak: Dict[str, int] = {}
+        self._idle_streak: Dict[str, int] = {}
+        self.scale_events: List[dict] = []
+        self.last_scale_event: Optional[dict] = None
+        self._warmed = False
+        self.registry = MetricsRegistry()
+        self.registry.add_collector(self._collect_metrics)
+
+    @staticmethod
+    def _check_role_coverage(roles: Sequence[str]) -> None:
+        for role in roles:
+            if role not in ("both", "prefill", "decode"):
+                raise ValueError(f"unknown replica role {role!r}")
+        if any(r != "both" for r in roles):
+            if not any(r in ("both", "prefill") for r in roles):
+                raise ValueError("split-role fleet has no prefill-capable "
+                                 "replica")
+            if not any(r in ("both", "decode") for r in roles):
+                raise ValueError("split-role fleet has no decode-capable "
+                                 "replica")
+
+    def _collect_metrics(self) -> None:
+        """Registry collector (runs at every snapshot/scrape): copy the
+        router-owned counters in — ``router_fleet_size`` and
+        ``router_transfers_total`` in ``/metrics``."""
+        reg = self.registry
+        reg.gauge("router/fleet_size").set(float(len(self.alive_replicas)))
+        reg.counter("router/transfers_total").value = float(self.transfers)
+        reg.counter("router/transfer_bytes_total").value = \
+            float(self.transfer_bytes)
+        reg.counter("router/prefix_routed_total").value = \
+            float(self.prefix_routed)
+        reg.gauge("router/transfers_in_flight").set(
+            float(self._transfers_in_flight))
+        for role in ("prefill", "decode", "both"):
+            idxs = self._role_indices(role)
+            reg.gauge(f"router/replicas_{role}").set(float(len(idxs)))
+            reg.gauge(f"router/load_{role}").set(
+                float(sum(self._load(i) for i in idxs)))
 
     # -- introspection -------------------------------------------------
     @property
@@ -109,6 +189,36 @@ class ReplicaRouter:
         return sum(r.scheduler.pending for i, r in enumerate(self.replicas)
                    if self._alive[i])
 
+    @property
+    def num_slots(self) -> int:
+        """Total decode capacity across the alive fleet (the frontend's
+        ``/healthz`` probe reads this where a single engine would report
+        ``pool.num_slots``)."""
+        return sum(self.replicas[i].pool.num_slots
+                   for i in self.alive_replicas)
+
+    @property
+    def step_id(self) -> int:
+        """Fleet progress marker: the furthest replica's step counter."""
+        return max((self.replicas[i].step_id
+                    for i in self.alive_replicas), default=0)
+
+    @property
+    def health_state(self) -> str:
+        """Aggregate fleet load state for the frontend. Admission needs
+        a prefill-capable replica and the router dispatches to the
+        least-loaded one, so the fleet is only overloaded when EVERY
+        prefill-capable replica is."""
+        order = {"healthy": 0, "pressured": 1, "overloaded": 2}
+        states = []
+        for i in self.prefill_capable:
+            lm = getattr(self.replicas[i], "_load", None)
+            states.append(lm.state.name.lower() if lm is not None
+                          else "healthy")
+        if not states:
+            return "overloaded"
+        return min(states, key=lambda s: order.get(s, 0))
+
     def has_work(self) -> bool:
         """Any alive replica holding queued, prefilling or running work —
         the bridge's step-gate probe (duck-typed: it prefers a callable
@@ -121,16 +231,37 @@ class ReplicaRouter:
     def _now(self) -> float:
         return self.replicas[0]._now()
 
+    # -- roles ---------------------------------------------------------
+    def _role_indices(self, role: str) -> List[int]:
+        return [i for i in self.alive_replicas if self.roles[i] == role]
+
+    @property
+    def prefill_capable(self) -> List[int]:
+        """Alive replicas that can run admission ('prefill' or 'both')."""
+        return [i for i in self.alive_replicas
+                if self.roles[i] in ("prefill", "both")]
+
+    @property
+    def decode_capable(self) -> List[int]:
+        """Alive replicas that can run the decode loop."""
+        return [i for i in self.alive_replicas
+                if self.roles[i] in ("decode", "both")]
+
     # -- dispatch ------------------------------------------------------
     def _load(self, i: int) -> int:
         r = self.replicas[i]
         return r.live_count + r.scheduler.pending
 
     def _rank(self, prompt, session: Optional[str]) -> List[int]:
-        """Replica indices in dispatch-preference order (alive only)."""
-        alive = self.alive_replicas
+        """Replica indices in dispatch-preference order. Admission (and
+        failover re-admission, which re-prefills) only ever lands on
+        prefill-capable replicas; decode-only replicas receive work
+        exclusively through the handoff path."""
+        alive = self.prefill_capable
         if not alive:
-            raise NoLiveReplicaError("all replicas have failed")
+            if not self.alive_replicas:
+                raise NoLiveReplicaError("all replicas have failed")
+            raise NoLiveReplicaError("no prefill-capable replica alive")
         if session is not None:
             home = self._session.get(session)
             if home is not None and self._alive[home]:
@@ -168,6 +299,7 @@ class ReplicaRouter:
                 self._tracked[req.request_id] = req
                 if session is not None:
                     self._session[session] = i
+                    self._req_session[req.request_id] = session
                 return req
         return req  # every replica rejected: surface the last verdict
 
@@ -187,9 +319,13 @@ class ReplicaRouter:
             except Exception:
                 self._alive[i] = False
                 self._fail_over(i)
+        self._drain_handoffs()
         for req in finished:
             self._tracked.pop(req.request_id, None)
             self._owner.pop(req.request_id, None)
+            self._req_session.pop(req.request_id, None)
+        if self.spawner is not None:
+            self.maybe_autoscale(self.spawner)
         if not any(self._alive):
             raise NoLiveReplicaError("all replicas have failed")
         return finished
@@ -222,6 +358,8 @@ class ReplicaRouter:
             _take(r)
         rep._slot_req.clear()
         rep._prefill_queue[:] = []
+        if getattr(rep, "_handoff_ready", None):
+            rep._handoff_ready.clear()
         # FAILED-by-abort requests the router still tracks: the engine
         # already charged the failure, but the CLIENT contract is that a
         # replica loss is invisible — resurrect and re-home them too
@@ -261,6 +399,244 @@ class ReplicaRouter:
             if idx == dead:
                 del self._session[key]
 
+    # -- disaggregated handoff orchestration ---------------------------
+    def _first_page_index(self) -> Dict[tuple, int]:
+        """The SHARED first-page index: first-page token tuple -> decode
+        replica whose prefix trie caches it. Rebuilt from the alive
+        decode pool's trie roots once per drain (root children ARE the
+        first-page edges), so prefix-affine handoff placement scores
+        hits across the WHOLE decode pool instead of one sticky
+        replica. Ties go to the lowest index — deterministic routing."""
+        index: Dict[tuple, int] = {}
+        for i in self.decode_capable:
+            trie = getattr(self.replicas[i].pool, "prefix", None)
+            if trie is None:
+                continue
+            for key in trie.root.children:
+                index.setdefault(key, i)
+        return index
+
+    def _pick_decode(self, req: Request,
+                     index: Dict[tuple, int]) -> Optional[int]:
+        """Decode replica for one handoff: sticky session first (the
+        conversation's earlier turns already decoded there), then the
+        shared first-page index (global prefix affinity — the transfer
+        itself shrinks by every trie-hit page), then least loaded.
+        Only replicas with a free slot qualify; ``None`` means park the
+        request and retry next step."""
+        ready = [i for i in self.decode_capable
+                 if self.replicas[i].pool._free_set]
+        if not ready:
+            return None
+        session = self._req_session.get(req.request_id)
+        if session is not None:
+            home = self._decode_session.get(session)
+            if home in ready:
+                self.affinity_hits += 1
+                return home
+        if self.affinity:
+            seed = np.asarray(req.seed_tokens).reshape(-1)
+            ps = getattr(self.replicas[ready[0]].pool, "page_size", 0)
+            if ps and len(seed) >= ps:
+                key = tuple(int(t) for t in seed[:ps])
+                home = index.get(key)
+                if home in ready:
+                    self.prefix_routed += 1
+                    return home
+        return min(ready, key=lambda i: (self._load(i), i))
+
+    def _transfer(self, req: Request, src_idx: int,
+                  index: Dict[tuple, int]) -> bool:
+        """Move one parked request from prefill replica ``src_idx`` to a
+        decode replica: ``adopt`` copies+seats the pages over there,
+        ``finish_handoff`` releases the source seat. The in-flight
+        counter brackets exactly this window — it must be zero again at
+        every step boundary. A failed adopt leaves the request parked
+        on the source (nothing seated on the destination — adopt
+        unwinds) for retry; a destination WEDGED enough to raise is
+        retired through the same path as a step failure."""
+        src = self.replicas[src_idx]
+        dst_idx = self._pick_decode(req, index)
+        if dst_idx is None:
+            return False
+        dst = self.replicas[dst_idx]
+        src_slot = req.slot
+        self._transfers_in_flight += 1
+        try:
+            stats = dst.adopt(req, src)
+        except Exception:
+            # mid-transfer death: adopt already unwound every page it
+            # touched on the destination; the request is STILL seated on
+            # the source, still parked, and retries on a sibling
+            self._alive[dst_idx] = False
+            self._fail_over(dst_idx)
+            return False
+        finally:
+            self._transfers_in_flight -= 1
+        src.finish_handoff(req, src_slot)
+        self._owner[req.request_id] = dst_idx
+        self.transfers += 1
+        self.transfer_bytes += int(stats["bytes"])
+        self.transfer_pages_saved += int(stats.get("hit_pages", 0))
+        self.registry.histogram("router/transfer_ms").observe(
+            stats["seconds"] * 1e3)
+        self.registry.histogram("router/transfer_pages",
+                                buckets=(1, 2, 4, 8, 16, 32, 64)).observe(
+            float(stats["pages"]))
+        session = self._req_session.get(req.request_id)
+        if session is not None:
+            self._decode_session[session] = dst_idx
+        return True
+
+    def _drain_handoffs(self) -> None:
+        """After every fleet step: hand each prefill replica's finished
+        prefills to the decode pool. Transfers complete synchronously
+        here (the engines' step loops never observe a half-moved
+        request)."""
+        srcs = [i for i in self.alive_replicas
+                if self.roles[i] == "prefill"
+                and self.replicas[i].pending_handoffs()]
+        if not srcs:
+            return
+        index = self._first_page_index() if self.affinity else {}
+        for i in srcs:
+            if not self._alive[i]:
+                continue  # retired by a failover during this drain
+            for req in self.replicas[i].pending_handoffs():
+                if self._transfer(req, i, index):
+                    # the adopted prompt is now cached on the destination
+                    # trie; keep the index current within this drain
+                    if self.affinity:
+                        index = self._first_page_index()
+
+    # -- elasticity ----------------------------------------------------
+    def add_replica(self, replica: ServingEngine,
+                    role: Optional[str] = None) -> int:
+        """Scale-out: join a replica to the rotation at runtime. The
+        newcomer must arrive TRAFFIC-WARMED (its provisioner drove a
+        warm sweep through every program family it will serve, the same
+        way the benches warm an arm before ``end_warmup``): when the
+        fleet is already past warmup the newcomer's watchdog arms
+        immediately, so a scale event compiles NOTHING post-warmup
+        (pinned by test). Returns the new replica index."""
+        role = role if role is not None else getattr(replica, "role", "both")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
+        i = len(self.replicas)
+        replica._next_id += i * ID_STRIDE
+        self.replicas.append(replica)
+        self._alive.append(True)
+        self.roles.append(role)
+        self.dispatched.append(0)
+        if self._warmed:
+            replica.end_warmup()
+        self._record_scale("add", i, role)
+        return i
+
+    def retire_replica(self, i: int) -> None:
+        """Scale-in: drain replica ``i`` through the failover scrub
+        (every request it owes — queued, mid-prefill, decoding, parked
+        for handoff — re-homes on a sibling with its generated tokens
+        as the resume seed; greedy output is bitwise identical) and
+        remove it from rotation. Refuses to retire the last replica of
+        a needed capability."""
+        if not (0 <= i < len(self.replicas)) or not self._alive[i]:
+            raise ValueError(f"replica {i} is not alive")
+        survivors = [j for j in self.alive_replicas if j != i]
+        if not survivors:
+            raise ValueError("cannot retire the last alive replica")
+        roles_left = [self.roles[j] for j in survivors]
+        if not any(r in ("both", "prefill") for r in roles_left):
+            raise ValueError("cannot retire the last prefill-capable "
+                             "replica")
+        if any(r != "both" for r in roles_left + [self.roles[i]]) \
+                and not any(r in ("both", "decode") for r in roles_left):
+            raise ValueError("cannot retire the last decode-capable "
+                             "replica")
+        self._alive[i] = False
+        self._fail_over(i)
+        self._record_scale("retire", i, self.roles[i])
+
+    def _record_scale(self, action: str, idx: int, role: str) -> None:
+        event = {"action": action, "replica": idx, "role": role,
+                 "time": self._now(),
+                 "fleet_size": len(self.alive_replicas)}
+        self.scale_events.append(event)
+        self.last_scale_event = event
+
+    def _role_hot(self, role: str, idxs: List[int]) -> bool:
+        """Sustained-overload signal for one role: any replica paging on
+        its burn-rate tracker, or (when no SLO tracker is configured)
+        saturated slots with a backlog. A decode role's backlog is the
+        fleet's PARKED HANDOFFS — pages filled upstream that cannot
+        seat downstream — since the router never queues fresh
+        submissions on a decode-only replica."""
+        parked = sum(len(self.replicas[j].pending_handoffs())
+                     for j in self.prefill_capable)
+        for i in idxs:
+            rep = self.replicas[i]
+            slo = getattr(rep, "slo", None)
+            if slo is not None and slo.alert_state == "page":
+                return True
+            backlog = rep.scheduler.pending
+            if role in ("decode", "both"):
+                backlog += parked
+            if rep.live_count >= rep.pool.num_slots and backlog > 0:
+                return True
+        return False
+
+    def _role_idle(self, idxs: List[int]) -> bool:
+        return all(self._load(i) == 0
+                   and not self.replicas[i].pending_handoffs()
+                   for i in idxs)
+
+    def maybe_autoscale(self, spawn) -> List[dict]:
+        """One elasticity decision pass (called each step when a
+        ``spawner`` is configured, or directly by an external control
+        loop). Per role: ``scale_patience`` consecutive hot checks →
+        ``spawn(role)`` joins a new replica of that role;
+        ``scale_patience`` consecutive idle checks with spare capacity
+        → the highest-indexed idle replica retires. Returns the scale
+        events this pass produced."""
+        before = len(self.scale_events)
+        for role in ("prefill", "decode", "both"):
+            idxs = self._role_indices(role)
+            if not idxs:
+                continue
+            if self._role_hot(role, idxs):
+                self._hot_streak[role] = self._hot_streak.get(role, 0) + 1
+                self._idle_streak[role] = 0
+                if self._hot_streak[role] >= self.scale_patience:
+                    self.add_replica(spawn(role), role)
+                    self._hot_streak[role] = 0
+            elif self._role_idle(idxs):
+                self._idle_streak[role] = self._idle_streak.get(role, 0) + 1
+                self._hot_streak[role] = 0
+                if self._idle_streak[role] >= self.scale_patience \
+                        and len(idxs) > 1:
+                    self.retire_replica(idxs[-1])
+                    self._idle_streak[role] = 0
+            else:
+                self._hot_streak[role] = 0
+                self._idle_streak[role] = 0
+        return self.scale_events[before:]
+
+    def fleet_topology(self) -> dict:
+        """The ``/healthz`` fleet block: per-role alive counts, transfer
+        progress, and the most recent scale event."""
+        return {
+            "roles": {role: self._role_indices(role)
+                      for role in ("prefill", "decode", "both")
+                      if self._role_indices(role)},
+            "counts": {role: len(self._role_indices(role))
+                       for role in ("prefill", "decode", "both")},
+            "fleet_size": len(self.alive_replicas),
+            "transfers_in_flight": self._transfers_in_flight,
+            "transfers_total": self.transfers,
+            "prefix_routed_total": self.prefix_routed,
+            "last_scale_event": self.last_scale_event,
+        }
+
     def run_until_drained(self, max_steps: Optional[int] = None,
                           stall_patience: Optional[int] = None
                           ) -> List[Request]:
@@ -289,6 +665,7 @@ class ReplicaRouter:
         return req
 
     def end_warmup(self) -> None:
+        self._warmed = True
         for i in self.alive_replicas:
             self.replicas[i].end_warmup()
 
@@ -304,8 +681,27 @@ class ReplicaRouter:
             raise AssertionError(
                 f"router _owner map holds {len(stale)} request id(s) "
                 f"no longer tracked: {sorted(stale)[:5]}")
+        # transfers are synchronous inside one drain: any in-flight
+        # count surviving to a step boundary is an accounting leak
+        if self._transfers_in_flight:
+            raise AssertionError(
+                f"{self._transfers_in_flight} page transfer(s) still "
+                f"in flight at a step boundary")
         for i in self.alive_replicas:
-            self.replicas[i].check_invariants()
+            rep = self.replicas[i]
+            # every parked handoff must belong to a prefill-role replica
+            # the router still tracks — an untracked parked request can
+            # never be adopted and would pin its slot forever
+            for r in rep.pending_handoffs():
+                if self.roles[i] != "prefill":
+                    raise AssertionError(
+                        f"replica {i} (role {self.roles[i]}) holds parked "
+                        f"handoff {r.request_id}")
+                if self._tracked.get(r.request_id) is not r:
+                    raise AssertionError(
+                        f"parked handoff {r.request_id} on replica {i} "
+                        f"is not router-tracked")
+            rep.check_invariants()
 
     @property
     def recompiles(self) -> int:
@@ -322,10 +718,18 @@ class ReplicaRouter:
         return {
             "replicas": self.num_replicas,
             "alive": self.alive_replicas,
+            "roles": list(self.roles),
             "dispatched": list(self.dispatched),
             "affinity_hits": self.affinity_hits,
             "spills": self.spills,
             "failovers": self.failovers,
+            "transfers": self.transfers,
+            "transfer_bytes": self.transfer_bytes,
+            "transfer_pages_saved": self.transfer_pages_saved,
+            "prefix_routed": self.prefix_routed,
+            "scale_events": len(self.scale_events),
+            "fleet": self.fleet_topology(),
+            "router_metrics": self.registry.snapshot(),
             "per_replica": {i: self.replicas[i].stats()
                             for i in self.alive_replicas},
         }

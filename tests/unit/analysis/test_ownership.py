@@ -78,6 +78,48 @@ def test_release_in_finally_stays_silent():
     assert not _errors(src)
 
 
+# ------------------------------- cross-pool page transfer primitive
+def test_transfer_import_leaks_when_seating_raises():
+    # import_pages hands back an OWNED batch; seat_pages can raise, so
+    # a bare import->seat with no unwind leaks the batch on that edge
+    src = (
+        "class E:\n"
+        "    def adopt(self, pool, spool, slot, pages, pos):\n"
+        "        dst = pool.import_pages(spool, pages)\n"
+        "        pool.seat_pages(slot, dst, pos)\n")
+    (f,) = _errors(src, "leak-on-exception-path")
+    assert f.line == 3 and "page" in f.message and "4" in f.message
+
+
+def test_transfer_unref_batch_on_seat_failure_stays_silent():
+    # the real adopt() shape: seat_pages is atomic, so its failure
+    # hands the WHOLE batch back via the bulk unref — owned-until-
+    # seated, then ownership transfers into the slot table
+    src = (
+        "class E:\n"
+        "    def adopt(self, pool, spool, slot, pages, pos):\n"
+        "        dst = pool.import_pages(spool, pages)\n"
+        "        try:\n"
+        "            pool.seat_pages(slot, dst, pos)\n"
+        "        except Exception:\n"
+        "            pool.unref_pages(dst)\n"
+        "            raise\n")
+    assert not _errors(src)
+
+
+def test_transfer_source_page_double_unref_fires():
+    # the source side of a transfer drops its reference exactly once;
+    # a second unref on the same handle is a double-release
+    src = (
+        "class E:\n"
+        "    def hand_off(self, pool):\n"
+        "        pid = pool.alloc_page()\n"
+        "        pool.unref_page(pid)\n"
+        "        pool.unref_page(pid)\n")
+    (f,) = _errors(src, "double-release")
+    assert f.line == 5
+
+
 # ------------------------------------------------- double-release
 def test_double_release_fires():
     src = (
@@ -212,9 +254,11 @@ def test_effect_table_pins_every_primitive():
         "future": {"acquire": ["create_future"],
                    "release": ["set_exception", "set_result"]},
         "lock": {"acquire": ["acquire"], "release": ["release"]},
-        "page": {"acquire": ["alloc_page"], "ref": ["ref_page"],
-                 "transfer": ["insert", "map_prefix", "seat_prefix"],
-                 "unref": ["unref_page"]},
+        "page": {"acquire": ["alloc_page", "import_pages"],
+                 "ref": ["ref_page"],
+                 "transfer": ["insert", "map_prefix", "seat_pages",
+                              "seat_prefix"],
+                 "unref": ["unref_page", "unref_pages"]},
         "seat": {"acquire": ["grant"],
                  "release": ["expire", "requeue_back", "requeue_front"],
                  "use": ["submit"]},

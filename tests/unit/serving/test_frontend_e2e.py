@@ -366,3 +366,56 @@ class TestHTTP:
 
         assert asyncio.run(run()) == 0
         _assert_clean(srv)
+
+
+# ---------------------------------------------------------------------------
+class TestFleetFrontend:
+    def test_healthz_fleet_topology_and_router_metrics(self, stack):
+        """The frontend over a DISAGGREGATED fleet: ``/healthz`` carries
+        the fleet block (per-role counts, transfers in flight, last
+        scale event) and ``/metrics`` the router gauges — with one
+        generation riding a real cross-pool page transfer end to end
+        over the socket."""
+        from deepspeed_tpu.serving.router import ReplicaRouter
+
+        _, _, engine = stack
+
+        def rep(role):
+            return ServingEngine(
+                engine, num_slots=2, max_queue_depth=32, prefill_chunk=8,
+                paged_kv={"page_size": 8, "num_pages": None}, role=role)
+
+        router = ReplicaRouter([rep("prefill"), rep("decode")])
+        fe = ServingFrontend(router, port=0, idle_poll_s=0.005)
+
+        async def run():
+            await fe.start()
+            try:
+                st, frames = await _generate(fe.port, {
+                    "prompt": list(range(1, 13)), "max_new_tokens": 4})
+                h = await _request(fe.port, "GET", "/healthz")
+                m = await _request(fe.port, "GET", "/metrics")
+            finally:
+                await fe.stop()
+            return st, frames, h, m
+
+        st, frames, (hst, _, hbody), (mst, mhdr, mbody) = asyncio.run(run())
+        assert st == 200 and frames[0][0] == "start"
+        assert frames[-1][0] == "done"
+        assert len([f for f in frames if f[0] == "token"]) == 4
+        assert hst == 200
+        info = json.loads(hbody)
+        assert info["state"] in ("healthy", "pressured")
+        assert info["num_slots"] == 4 and info["live_slots"] == 0
+        fleet = info["fleet"]
+        assert fleet["counts"] == {"prefill": 1, "decode": 1, "both": 0}
+        assert fleet["fleet_size"] == 2
+        assert fleet["transfers_in_flight"] == 0
+        assert fleet["transfers_total"] >= 1
+        assert "last_scale_event" in fleet
+        assert mst == 200
+        assert mhdr["content-type"].startswith("text/plain")
+        text = mbody.decode("utf-8")
+        assert "router_fleet_size 2" in text
+        assert "router_transfers_total" in text
+        router.check_invariants()
