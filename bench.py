@@ -1421,12 +1421,20 @@ def serving_disagg_main():
     routed via the shared first-page index and the transfer pages a
     destination trie hit kept off the wire.
 
+    ``detail.journeys`` / ``detail.transfer_latency_p99_ms`` /
+    ``detail.efficiency`` report the fleet observability plane over the
+    disaggregated arm: cross-replica journey completeness (every
+    terminal journey stitches with all homes closed), the merged
+    per-transfer latency tail, fleet goodput and the instrumentation
+    overhead as a fraction of accumulated step wall.
+
     Example::
 
         python bench.py serving-disagg --json BENCH_serving_disagg.json \\
             --signatures signatures.json
         python check_regression.py BENCH_serving_disagg.json \\
             BENCH_serving_disagg.json --metric value:lower \\
+            --max-overhead-pct 3 --require-complete-journeys \\
             --max-recompiles 0 --require-zero-leaks \\
             --signatures-json signatures.json --require-signature-match
     """
@@ -1513,7 +1521,7 @@ def serving_disagg_main():
         return ServingEngine(eng, num_slots=slots,
                              max_queue_depth=2 * n_req, prefill_chunk=ps,
                              prefill_token_budget=budget,
-                             strict_recompile=True, role=role,
+                             strict_recompile=True, role=role, slo=True,
                              paged_kv={"page_size": ps,
                                        "num_pages": num_pages})
 
@@ -1612,6 +1620,9 @@ def serving_disagg_main():
             rep = router.replicas[i]
             rep.metrics = ServingMetrics(None, registry=rep.registry,
                                          step_fn=lambda s=rep: s.step_id)
+            # overhead_pct measures the TIMED phase only: drop the
+            # warmup's instrumentation time and step wall
+            rep.reset_efficiency_window()
         reqs, i, step = [], 0, 0
         t0 = time.perf_counter()
         while i < n_req or router.has_work():
@@ -1670,6 +1681,13 @@ def serving_disagg_main():
         1, dstats["transfer_bytes"] // dec.pool.page_nbytes)
     saved = dstats["transfer_pages_saved"]
 
+    # fleet observability detail (the --require-complete-journeys /
+    # --max-overhead-pct gates read these): journey completeness over
+    # the whole disaggregated run, merged transfer-latency tail, and
+    # fleet goodput + instrumentation overhead from FleetTelemetry
+    journeys = disagg.journey_summary()
+    fleet_eff = disagg.fleet.efficiency_snapshot()
+
     def arm_detail(runs):
         return {"decode_gap_p50_ms": round(_med(runs,
                                                 "decode_gap_p50_ms"), 2),
@@ -1703,6 +1721,14 @@ def serving_disagg_main():
             "replications": reps,
             "transfers": dstats["transfers"],
             "transfer_bytes": dstats["transfer_bytes"],
+            "transfer_latency_p99_ms": round(
+                disagg.transfer_latency.quantile(0.99), 3),
+            "journeys": journeys,
+            "efficiency": {
+                "goodput_slo": round(fleet_eff["goodput_slo"], 4),
+                "overhead_pct": round(
+                    fleet_eff.get("overhead_pct", 0.0), 3),
+            },
             "prefix": {
                 "prefix_routed_handoffs": dstats["prefix_routed"],
                 "transfer_pages_saved": int(saved),

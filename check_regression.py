@@ -45,6 +45,21 @@ must be exactly 0 and ``detail.invariants_ok`` /
 candidate alone — a leaked slot under fault injection is a bug, not a
 regression to be thresholded.
 
+``--require-complete-journeys`` gates the fleet observability
+invariant the ``serving-disagg`` row reports: the candidate's
+``detail.journeys.complete`` must equal ``detail.journeys.finished``
+— every cross-replica request journey that reached a terminal hop
+(finish/reject/cancel/failed) must stitch COMPLETE: every home's
+timeline closed and no request parked mid-handoff. Absolute on the
+candidate alone; a missing or non-numeric ``detail.journeys`` block is
+a usage error (exit 2), so a bench that silently stopped emitting the
+block can never pass::
+
+    python check_regression.py BENCH_serving_disagg.base.json \
+        BENCH_serving_disagg.json \
+        --max-overhead-pct 3 --require-complete-journeys \
+        --max-recompiles 0
+
 ``--min-goodput FRAC`` and ``--max-overhead-pct X`` gate the
 ``efficiency`` detail block the serving-stall and paging rows report
 from the runtime cost model + SLO tracker: the candidate's
@@ -254,6 +269,13 @@ def main(argv=None) -> int:
                          "enumerated signature set must equal the "
                          "--signatures-json runtime warmup manifest in "
                          "both directions (no jax import)")
+    ap.add_argument("--require-complete-journeys", action="store_true",
+                    help="absolute gate on the candidate's fleet "
+                         "journey completeness (serving-disagg row): "
+                         "detail.journeys.complete == "
+                         "detail.journeys.finished — every journey that "
+                         "reached a terminal hop must stitch with all "
+                         "homes closed and nothing parked")
     ap.add_argument("--require-zero-leaks", action="store_true",
                     help="absolute gate on the candidate's fault-"
                          "tolerance invariants (serving-chaos row): "
@@ -326,6 +348,14 @@ def main(argv=None) -> int:
             print(f"{'ok' if val else 'REGRESSION':>10}  {dotted} "
                   f"(absolute): candidate={val} required=True")
             failed |= not val
+    if args.require_complete_journeys:
+        fin = _resolve(cand, "detail.journeys.finished", args.candidate)
+        comp = _resolve(cand, "detail.journeys.complete", args.candidate)
+        worse = comp != fin
+        tag = "REGRESSION" if worse else "ok"
+        print(f"{tag:>10}  detail.journeys (absolute): "
+              f"complete={comp:g} finished={fin:g} required=equal")
+        failed |= worse
     if args.max_recompiles is not None:
         dotted = "detail.recompiles_after_warmup"
         r = _resolve(cand, dotted, args.candidate)

@@ -324,6 +324,12 @@ class ServingEngine:
         self._tokens_emitted = 0        # lifetime tokens (all paths)
         self._tokens_prev = 0           # snapshot for per-step deltas
         self._telemetry_ns = 0          # step-boundary instrumentation
+        # fleet identity: assigned by ReplicaRouter at join time, stamped
+        # onto every timeline event so cross-replica journeys stitch
+        self.replica_id: Optional[int] = None
+        # accumulated step wall — the overhead_pct denominator when no
+        # cost model is attached (the fleet aggregator's fallback)
+        self.step_wall_s = 0.0
         self.registry.add_collector(self._collect_telemetry_health)
         if self._paged:
             # pool-internal events (CoW copies, trie evictions) land in
@@ -693,6 +699,10 @@ class ServingEngine:
         rec = {
             "step_id": self.step_id,
             "t_unix": time.time(),
+            # the shared injected clock: fleet post-mortems align every
+            # replica's ring on this axis, not the per-replica step_id
+            "t": self._now(),
+            "replica": self.replica_id,
             "wall_ms": wall * 1e3,
             "live": len(self._slot_req),
             "pending": self.scheduler.pending,
@@ -772,6 +782,10 @@ class ServingEngine:
             out["ttft_p99_ms"] = ss["ttft_p99_ms"]
             out["gap_p99_ms"] = ss["gap_p99_ms"]
             out["alert_state"] = ss["alert_state"]
+        if not wall:
+            # no cost model: fall back to the accumulated step wall so
+            # overhead_pct is still honest on SLO-only configurations
+            wall = self.step_wall_s
         if wall:
             out["overhead_pct"] = 100.0 * out["telemetry_overhead_s"] / wall
         return out
@@ -785,6 +799,7 @@ class ServingEngine:
         if self.slo is not None:
             self.slo.reset()
         self._telemetry_ns = 0
+        self.step_wall_s = 0.0
         self._tokens_prev = self._tokens_emitted
 
     def _chaos_corrupt_state(self) -> None:
@@ -1411,8 +1426,12 @@ class ServingEngine:
                 # seated (slot + page references held) until the router
                 # transfers it or a rollback path retires it.
                 self._handoff_ready.append(req)
+                # parked: prefill done but no decode home yet — the
+                # completeness probe must not count this as done even
+                # though the timeline is still open
                 self.timelines.record(req.request_id, "handoff_ready",
-                                      slot=req.slot)
+                                      parked=True, slot=req.slot,
+                                      journey=req.journey_id)
             return
         req.state = RequestState.FINISHED
         req.finish_time = self._now()
@@ -1538,13 +1557,17 @@ class ServingEngine:
             pool.cache_prefix(slot, seed)
         self.timelines.record(req.request_id, "adopted", slot=slot,
                               pages=len(dst_pages),
-                              hit_pages=len(hit_pages))
+                              hit_pages=len(hit_pages),
+                              src_replica=src.replica_id,
+                              dst_replica=self.replica_id,
+                              journey=req.journey_id)
         self.tracer.flow("s", "req", req.request_id)
         return {"pages": len(dst_pages), "hit_pages": len(hit_pages),
                 "bytes": len(dst_pages) * pool.page_nbytes,
                 "seconds": now - t0}
 
-    def finish_handoff(self, req: Request, slot: int) -> None:
+    def finish_handoff(self, req: Request, slot: int,
+                       dst_replica: Optional[int] = None) -> None:
         """Prefill role: release the source seat AFTER a decode replica
         adopted the request. ``slot`` is the source slot (``req.slot``
         already points at the destination). The slot and its page
@@ -1561,7 +1584,9 @@ class ServingEngine:
             self._handoff_ready[:] = [r for r in self._handoff_ready
                                       if r is not req]
         self.timelines.record(req.request_id, "handed_off", terminal=True,
-                              slot=slot)
+                              slot=slot, src_replica=self.replica_id,
+                              dst_replica=dst_replica,
+                              journey=req.journey_id)
 
     # -- resilience: eviction, deadlines, preemption -------------------
     def _evict_slot(self, req: Request) -> None:
@@ -1840,6 +1865,7 @@ class ServingEngine:
             # check_invariants + the flight recorder face REAL damage
             self._chaos_corrupt_state()
         wall = self._now() - t_step
+        self.step_wall_s += wall
         self._telemetry_step(wall, running_at_entry, granted, finished)
         # drain serving/step_fetch (the single-sync wait) into
         # timer/*_ms histograms alongside the rest of the step metrics

@@ -21,9 +21,13 @@ points (DeepSpeed-MII's REST/gRPC shell around the inference engine):
   bridge fronts a :class:`ReplicaRouter` the payload gains a ``fleet``
   object (per-role replica counts, transfers in flight, last scale
   event) and the load state aggregates over prefill-capable replicas.
-* ``GET /metrics`` — the existing Prometheus exposition
-  (``MetricsRegistry.to_prometheus``); a router adds its fleet gauges
-  (``router_fleet_size``, ``router_transfers_total``, ...).
+* ``GET /metrics`` — the Prometheus exposition. A bare engine serves
+  its own ``MetricsRegistry.to_prometheus``; a router serves the
+  MERGED fleet exposition (``FleetTelemetry.to_prometheus``): router
+  series unlabeled, every replica's series labeled
+  ``replica="i",role="..."``, plus derived ``fleet_*`` gauges
+  (merged-digest p50/p99, fleet goodput/burn, journey completeness,
+  transfer-latency quantiles).
 
 Every engine interaction goes through the :class:`AsyncEngineBridge`
 (one dedicated step thread; see ``bridge.py``) — handlers never touch
@@ -209,8 +213,13 @@ class ServingFrontend:
         elif path == "/healthz":
             await self._healthz(writer)
         elif path == "/metrics":
+            # a router fronts a FLEET: serve the merged exposition
+            # (router series unlabeled, replica series replica=/role=
+            # labeled, fleet_* gauges derived from merged digests)
             text = await self.bridge.call(
-                lambda srv: srv.registry.to_prometheus())
+                lambda srv: srv.fleet.to_prometheus()
+                if hasattr(srv, "fleet")
+                else srv.registry.to_prometheus())
             writer.write(_response(200, text.encode("utf-8"),
                                    "text/plain; version=0.0.4"))
         else:
@@ -332,6 +341,10 @@ class ServingFrontend:
                 out["goodput"] = slo.goodput()
             if hasattr(srv, "fleet_topology"):
                 out["fleet"] = srv.fleet_topology()
+            if hasattr(srv, "fleet"):
+                # fleet health: per-replica alert states, per-role
+                # queue depth/backlog, journey completeness
+                out["fleet_health"] = srv.fleet.health_summary()
             return out
 
         info = await self.bridge.call(probe)

@@ -113,6 +113,14 @@ class Request:
     #                                         into the slot (chunked prefill)
     output_tokens: List[int] = dataclasses.field(default_factory=list)
 
+    # -- fleet trace context --------------------------------------------
+    # minted by ReplicaRouter.submit and carried across every replica
+    # boundary (handoff, page transfer, failover) so each home's
+    # Tracer/TimelineStore stamps the same journey; None on a bare
+    # single-engine deployment
+    journey_id: Optional[int] = None
+    hop: int = 0                            # replica-boundary crossings
+
     # -- resilience -----------------------------------------------------
     deadline_ms: Optional[float] = None     # TTL from submit; None = none
     deadline_time: Optional[float] = None   # absolute perf_counter stamp

@@ -56,6 +56,20 @@ trie-hit page), then least-loaded. Transfers are synchronous within the
 drain — ``transfers_in_flight`` must read zero at every step boundary
 (audited by :meth:`check_invariants`).
 
+Fleet observability (ISSUE 20): every routed request carries a
+*journey* — a fleet-unique trace context minted at submit and stamped
+onto each home replica's TimelineStore events — and the router logs a
+hop at every boundary it controls (dispatch, page transfer, failover,
+terminal). :meth:`journey` stitches the cross-replica record into one
+ordered timeline; :meth:`export_trace` renders the whole fleet as ONE
+Perfetto document (one process lane per replica plus the router's own,
+flow arrows across handoff/transfer/failover boundaries, scale events
+as instant markers); ``router.fleet`` (a
+:class:`~deepspeed_tpu.telemetry.fleet.FleetTelemetry`) merges every
+replica's registry/digests into one labeled Prometheus exposition and
+writes ONE fleet-scoped post-mortem when any replica dies on a fatal
+condition.
+
 The fleet is ELASTIC: :meth:`add_replica` / :meth:`retire_replica`
 reshape it at runtime (retirement drains through the same failover
 scrub — greedy output is bitwise identical to never having moved), and
@@ -66,13 +80,20 @@ idling with spare replicas retires one.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry.fleet import FleetTelemetry
 from ..telemetry.registry import MetricsRegistry
+from ..telemetry.slo import QuantileDigest
+from ..telemetry.tracer import Tracer, export_merged
+from ..telemetry.watchdog import RecompileAfterWarmupError
 from .engine import ServingEngine
 from .request import FinishReason, Request, RequestState
+from .resilience import InvariantViolation, ServingStalledError
 
 # id-space stride per replica: replica i issues ids in
 # [i*ID_STRIDE, (i+1)*ID_STRIDE) — collision would need a billion
@@ -98,7 +119,10 @@ class ReplicaRouter:
     def __init__(self, replicas: Sequence[ServingEngine],
                  affinity: bool = True,
                  spawner: Optional[Any] = None,
-                 scale_patience: int = 3):
+                 scale_patience: int = 3,
+                 tracer: Optional[Tracer] = None,
+                 dump_dir: Optional[str] = None,
+                 journey_capacity: int = 4096):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         self.replicas: List[ServingEngine] = list(replicas)
@@ -111,6 +135,7 @@ class ReplicaRouter:
             # offset, don't overwrite: a replica with prior traffic keeps
             # its issued ids unique within its own stripe
             rep._next_id += i * ID_STRIDE
+            self._join_observability(i, rep)
         self._owner: Dict[int, int] = {}       # request_id -> replica idx
         self._session: Dict[str, int] = {}     # session key -> replica idx
         self._tracked: Dict[int, Request] = {}  # live (non-terminal) reqs
@@ -137,6 +162,24 @@ class ReplicaRouter:
         self._warmed = False
         self.registry = MetricsRegistry()
         self.registry.add_collector(self._collect_metrics)
+        # -- fleet observability (ISSUE 20) ----------------------------
+        # the router's OWN tracer: dispatch/transfer spans, failover
+        # and scale-event instants — one extra process lane in the
+        # merged Perfetto export. Disabled by default like the engine's.
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.tracer.process_name = "router"
+        self.dump_dir = dump_dir
+        # request journeys: jid -> {request_id, hops, homes, terminal},
+        # a bounded log — the fleet post-mortem's dispatch record and
+        # the stitcher's spine
+        self._journey_seq = 0
+        self._journey_capacity = int(journey_capacity)
+        self._journeys: "OrderedDict[int, dict]" = OrderedDict()
+        self._rid_journey: Dict[int, int] = {}
+        self._journey_ns = 0       # self-timed bookkeeping (overhead_pct)
+        # per-transfer wire latency, mergeable into the fleet exposition
+        self.transfer_latency = QuantileDigest()
+        self.fleet = FleetTelemetry(self, dump_dir=dump_dir)
 
     @staticmethod
     def _check_role_coverage(roles: Sequence[str]) -> None:
@@ -151,6 +194,17 @@ class ReplicaRouter:
                 raise ValueError("split-role fleet has no decode-capable "
                                  "replica")
 
+    def _join_observability(self, i: int, rep: ServingEngine) -> None:
+        """Stamp fleet identity onto a joining replica: ``replica_id``
+        on the engine and its TimelineStore (every timeline event then
+        carries ``replica=i`` for the journey stitcher) and a process
+        name on its tracer (the Perfetto process-lane label in the
+        merged export)."""
+        rep.replica_id = i
+        rep.timelines.replica_id = i
+        rep.tracer.process_name = \
+            f"replica{i}:{getattr(rep, 'role', 'both')}"
+
     def _collect_metrics(self) -> None:
         """Registry collector (runs at every snapshot/scrape): copy the
         router-owned counters in — ``router_fleet_size`` and
@@ -160,6 +214,13 @@ class ReplicaRouter:
         reg.counter("router/transfers_total").value = float(self.transfers)
         reg.counter("router/transfer_bytes_total").value = \
             float(self.transfer_bytes)
+        # stats["bytes"] counts only pages that crossed pools (trie-hit
+        # pages never move), so the bytes counter IS wire bytes
+        reg.counter("router/transfer_wire_bytes_total").value = \
+            float(self.transfer_bytes)
+        reg.counter("router/failovers_total").value = float(self.failovers)
+        reg.counter("router/journeys_total").value = \
+            float(self._journey_seq)
         reg.counter("router/prefix_routed_total").value = \
             float(self.prefix_routed)
         reg.gauge("router/transfers_in_flight").set(
@@ -247,6 +308,149 @@ class ReplicaRouter:
         return [i for i in self.alive_replicas
                 if self.roles[i] in ("decode", "both")]
 
+    # -- request journeys (ISSUE 20) -----------------------------------
+    _TERMINAL_HOPS = ("finish", "reject", "cancel", "failed")
+
+    def _mint_journey(self, req: Request) -> int:
+        """Trace context for one request: a fleet-unique journey id
+        (its own counter — request ids are striped per replica, so
+        replica 0's ids would collide with a unified journey space)."""
+        jid = self._journey_seq
+        self._journey_seq += 1
+        self._journeys[jid] = {"id": jid, "request_id": req.request_id,
+                               "hops": [], "homes": [], "terminal": None}
+        while len(self._journeys) > self._journey_capacity:
+            _, old = self._journeys.popitem(last=False)
+            self._rid_journey.pop(old["request_id"], None)
+        self._rid_journey[req.request_id] = jid
+        req.journey_id = jid
+        return jid
+
+    def _hop(self, req: Request, kind: str,
+             replica: Optional[int] = None, **attrs) -> None:
+        """Append one replica-boundary crossing to the request's
+        journey (dispatch, transfer, failover, terminal). Self-timed:
+        this is the router's only hot-path observability cost, and the
+        fleet ``overhead_pct`` must charge it honestly."""
+        t0 = time.perf_counter_ns()
+        jid = req.journey_id
+        rec = self._journeys.get(jid) if jid is not None else None
+        if rec is not None:
+            req.hop += 1
+            hop = {"kind": kind, "hop": req.hop, "t": self._now(),
+                   "replica": replica}
+            hop.update(attrs)
+            rec["hops"].append(hop)
+            if replica is not None and replica not in rec["homes"]:
+                rec["homes"].append(replica)
+            if kind in self._TERMINAL_HOPS:
+                rec["terminal"] = kind
+        self._journey_ns += time.perf_counter_ns() - t0
+
+    @property
+    def journey_overhead_s(self) -> float:
+        return self._journey_ns / 1e9
+
+    def journey_of(self, request_id: int) -> Optional[int]:
+        """Journey id for a request id (None once evicted/unknown)."""
+        return self._rid_journey.get(request_id)
+
+    def journey(self, journey_id: int) -> Optional[dict]:
+        """The STITCHER: merge one journey's cross-replica record.
+
+        Returns the router's hop log plus every home replica's timeline
+        events for the request — each event stamped with its replica —
+        in one list ordered on the shared ``perf_counter_ns`` clock
+        (router hops carry the injected-clock ``t``, converted to ns on
+        the same epoch when the default clock is in use). ``complete``
+        is the fleet-truth probe: a terminal hop was recorded AND no
+        home's timeline is still open or parked mid-handoff — a request
+        stranded between homes is complete on NEITHER."""
+        rec = self._journeys.get(journey_id)
+        if rec is None:
+            return None
+        rid = rec["request_id"]
+        events: List[dict] = []
+        open_homes: List[int] = []
+        parked_homes: List[int] = []
+        for i, rep in enumerate(self.replicas):
+            tl = rep.timelines.get(rid)
+            if not tl:
+                continue
+            for e in tl:
+                events.append({"t_ns": e["t_ns"], "replica": i,
+                               "source": "timeline",
+                               "event": e["event"], "attrs": e["attrs"]})
+            if rep.timelines.is_open(rid):
+                open_homes.append(i)
+            if rid in rep.timelines.parked_ids():
+                parked_homes.append(i)
+        for h in rec["hops"]:
+            events.append({"t_ns": int(h["t"] * 1e9),
+                           "replica": h.get("replica"),
+                           "source": "router", "event": h["kind"],
+                           "attrs": {k: v for k, v in h.items()
+                                     if k not in ("kind", "t")}})
+        events.sort(key=lambda e: e["t_ns"])
+        complete = (rec["terminal"] is not None
+                    and not open_homes and not parked_homes)
+        return {"id": journey_id, "request_id": rid,
+                "hops": list(rec["hops"]), "homes": list(rec["homes"]),
+                "terminal": rec["terminal"], "events": events,
+                "complete": complete, "open_homes": open_homes,
+                "parked_homes": parked_homes}
+
+    def journey_summary(self) -> dict:
+        """Fleet completeness rollup: of the journeys that reached a
+        terminal hop, how many stitch COMPLETE (every home's timeline
+        closed, none parked). The ``--require-complete-journeys`` gate
+        holds ``complete == finished``."""
+        finished = complete = 0
+        incomplete: List[int] = []
+        for jid, rec in list(self._journeys.items()):
+            if rec["terminal"] is None:
+                continue
+            finished += 1
+            j = self.journey(jid)
+            if j is not None and j["complete"]:
+                complete += 1
+            else:
+                incomplete.append(jid)
+        return {"total": len(self._journeys), "finished": finished,
+                "complete": complete, "incomplete": incomplete[:16]}
+
+    def recent_journeys(self, n: int = 32) -> List[dict]:
+        """Tail of the journey log (hops only, no timeline merge) — the
+        router's dispatch record inside the fleet post-mortem."""
+        out = []
+        for jid in list(self._journeys)[-n:]:
+            rec = self._journeys[jid]
+            out.append({"id": jid, "request_id": rec["request_id"],
+                        "homes": list(rec["homes"]),
+                        "terminal": rec["terminal"],
+                        "hops": list(rec["hops"])})
+        return out
+
+    def export_trace(self, path: str) -> int:
+        """Write ONE merged Perfetto document for the whole fleet: the
+        router's lane first (dispatch spans, scale/failover instants),
+        then one process lane per replica; flow arrows drawn at every
+        handoff/transfer/failover pair render across lanes. Returns
+        the event count."""
+        tracers: List[Tuple[str, Tracer]] = [("router", self.tracer)]
+        for i, rep in enumerate(self.replicas):
+            tracers.append((f"replica{i}:{self.roles[i]}", rep.tracer))
+        return export_merged(path, tracers)
+
+    def _classify_failure(self, error: BaseException) -> str:
+        if isinstance(error, InvariantViolation):
+            return "invariant_violation"
+        if isinstance(error, ServingStalledError):
+            return "stalled"
+        if isinstance(error, RecompileAfterWarmupError):
+            return "recompile_after_warmup"
+        return "replica_error"
+
     # -- dispatch ------------------------------------------------------
     def _load(self, i: int) -> int:
         r = self.replicas[i]
@@ -300,7 +504,16 @@ class ReplicaRouter:
                 if session is not None:
                     self._session[session] = i
                     self._req_session[req.request_id] = session
+                self._mint_journey(req)
+                self._hop(req, "dispatch", replica=i, spills=n)
                 return req
+        if req is not None:
+            # every replica rejected: the journey still exists (and is
+            # terminal) so a refused request audits like any other
+            self._mint_journey(req)
+            self._hop(req, "reject",
+                      reason=str(req.reject_reason)
+                      if req.reject_reason else None)
         return req  # every replica rejected: surface the last verdict
 
     # -- stepping ------------------------------------------------------
@@ -316,11 +529,22 @@ class ReplicaRouter:
                 continue
             try:
                 finished.extend(rep.step())
-            except Exception:
+            except Exception as e:
                 self._alive[i] = False
+                # ONE fleet-scoped post-mortem before the scrub mutates
+                # anything: every replica's ring + the router's journey
+                # and scale log, trigger replica marked
+                self.fleet.dump(self._classify_failure(e), error=e,
+                                trigger_replica=i)
+                self.tracer.instant("router/replica_failed", replica=i,
+                                    reason=self._classify_failure(e))
                 self._fail_over(i)
         self._drain_handoffs()
         for req in finished:
+            self._hop(req, "finish",
+                      replica=self._owner.get(req.request_id),
+                      reason=str(req.finish_reason)
+                      if req.finish_reason else None)
             self._tracked.pop(req.request_id, None)
             self._owner.pop(req.request_id, None)
             self._req_session.pop(req.request_id, None)
@@ -387,11 +611,32 @@ class ReplicaRouter:
                     self._tracked[r.request_id] = r
                     self.failovers += 1
                     placed = True
+                    # close the corpse's timeline (terminal: nothing
+                    # more will ever be recorded there) and open the
+                    # re-home on the inheritor, flow arrow across lanes
+                    rep.timelines.record(
+                        r.request_id, "failed_over", terminal=True,
+                        src_replica=dead, dst_replica=i,
+                        journey=r.journey_id)
+                    self.replicas[i].timelines.record(
+                        r.request_id, "resumed", src_replica=dead,
+                        dst_replica=i, journey=r.journey_id,
+                        preemptions=r.preemptions)
+                    if r.journey_id is not None:
+                        rep.tracer.flow("s", "journey", r.journey_id,
+                                        cat="journey")
+                        self.replicas[i].tracer.flow(
+                            "f", "journey", r.journey_id, cat="journey")
+                    self._hop(r, "failover", replica=i, src=dead)
                     break
             if not placed:
                 r.state = RequestState.FAILED
                 r.finish_reason = FinishReason.ERROR
                 r.finish_time = self._now()
+                rep.timelines.record(r.request_id, "failed",
+                                     terminal=True, src_replica=dead,
+                                     journey=r.journey_id)
+                self._hop(r, "failed", src=dead)
                 self._tracked.pop(r.request_id, None)
                 self._owner.pop(r.request_id, None)
         # sticky sessions homed on the corpse re-route on next submit
@@ -461,28 +706,51 @@ class ReplicaRouter:
             return False
         dst = self.replicas[dst_idx]
         src_slot = req.slot
+        jid = req.journey_id
         self._transfers_in_flight += 1
+        if jid is not None:
+            # flow start on the SOURCE lane; the finish lands on the
+            # destination lane after adoption — the arrow crosses the
+            # process boundary in the merged export
+            src.tracer.flow("s", "journey", jid, cat="journey")
         try:
-            stats = dst.adopt(req, src)
-        except Exception:
+            with self.tracer.span("router/transfer", journey=jid,
+                                  src=src_idx, dst=dst_idx,
+                                  request=req.request_id):
+                stats = dst.adopt(req, src)
+        except Exception as e:
             # mid-transfer death: adopt already unwound every page it
             # touched on the destination; the request is STILL seated on
             # the source, still parked, and retries on a sibling
             self._alive[dst_idx] = False
+            self.fleet.dump(self._classify_failure(e), error=e,
+                            trigger_replica=dst_idx)
             self._fail_over(dst_idx)
             return False
         finally:
             self._transfers_in_flight -= 1
-        src.finish_handoff(req, src_slot)
+        src.finish_handoff(req, src_slot, dst_replica=dst_idx)
+        if jid is not None:
+            dst.tracer.flow("f", "journey", jid, cat="journey")
         self._owner[req.request_id] = dst_idx
         self.transfers += 1
-        self.transfer_bytes += int(stats["bytes"])
+        wire_bytes = int(stats["bytes"])
+        self.transfer_bytes += wire_bytes
         self.transfer_pages_saved += int(stats.get("hit_pages", 0))
+        self.transfer_latency.add(stats["seconds"] * 1e3)
         self.registry.histogram("router/transfer_ms").observe(
             stats["seconds"] * 1e3)
         self.registry.histogram("router/transfer_pages",
                                 buckets=(1, 2, 4, 8, 16, 32, 64)).observe(
             float(stats["pages"]))
+        self.registry.histogram(
+            "router/transfer_wire_bytes",
+            buckets=(1024, 4096, 16384, 65536, 262144, 1048576,
+                     4194304, 16777216)).observe(float(wire_bytes))
+        self._hop(req, "transfer", replica=dst_idx, src=src_idx,
+                  pages=int(stats["pages"]),
+                  hit_pages=int(stats.get("hit_pages", 0)),
+                  bytes=wire_bytes, ms=stats["seconds"] * 1e3)
         session = self._req_session.get(req.request_id)
         if session is not None:
             self._decode_session[session] = dst_idx
@@ -528,6 +796,7 @@ class ReplicaRouter:
         self._alive.append(True)
         self.roles.append(role)
         self.dispatched.append(0)
+        self._join_observability(i, replica)
         if self._warmed:
             replica.end_warmup()
         self._record_scale("add", i, role)
@@ -563,6 +832,11 @@ class ReplicaRouter:
                  "fleet_size": len(self.alive_replicas)}
         self.scale_events.append(event)
         self.last_scale_event = event
+        # instant marker on the router lane: scale events punctuate the
+        # merged fleet trace alongside the journeys they reshape
+        self.tracer.instant("router/scale", action=action, replica=idx,
+                            role=role,
+                            fleet_size=len(self.alive_replicas))
 
     def _role_hot(self, role: str, idxs: List[int]) -> bool:
         """Sustained-overload signal for one role: any replica paging on
@@ -660,6 +934,7 @@ class ReplicaRouter:
             return None
         req = self.replicas[idx].cancel(request_id)
         if req is not None:
+            self._hop(req, "cancel", replica=idx)
             self._tracked.pop(request_id, None)
             self._owner.pop(request_id, None)
         return req
@@ -676,32 +951,44 @@ class ReplicaRouter:
         # ownership entries may not outlive tracking: _owner and
         # _tracked are populated and retired together, so a stale
         # _owner key is an unbounded host-side leak
-        stale = set(self._owner) - set(self._tracked)
-        if stale:
-            raise AssertionError(
-                f"router _owner map holds {len(stale)} request id(s) "
-                f"no longer tracked: {sorted(stale)[:5]}")
-        # transfers are synchronous inside one drain: any in-flight
-        # count surviving to a step boundary is an accounting leak
-        if self._transfers_in_flight:
-            raise AssertionError(
-                f"{self._transfers_in_flight} page transfer(s) still "
-                f"in flight at a step boundary")
+        try:
+            stale = set(self._owner) - set(self._tracked)
+            if stale:
+                raise AssertionError(
+                    f"router _owner map holds {len(stale)} request id(s) "
+                    f"no longer tracked: {sorted(stale)[:5]}")
+            # transfers are synchronous inside one drain: any in-flight
+            # count surviving to a step boundary is an accounting leak
+            if self._transfers_in_flight:
+                raise AssertionError(
+                    f"{self._transfers_in_flight} page transfer(s) still "
+                    f"in flight at a step boundary")
+        except AssertionError as e:
+            self.fleet.dump("invariant_violation", error=e)
+            raise
         for i in self.alive_replicas:
             rep = self.replicas[i]
-            # every parked handoff must belong to a prefill-role replica
-            # the router still tracks — an untracked parked request can
-            # never be adopted and would pin its slot forever
-            for r in rep.pending_handoffs():
-                if self.roles[i] != "prefill":
-                    raise AssertionError(
-                        f"replica {i} (role {self.roles[i]}) holds parked "
-                        f"handoff {r.request_id}")
-                if self._tracked.get(r.request_id) is not r:
-                    raise AssertionError(
-                        f"parked handoff {r.request_id} on replica {i} "
-                        f"is not router-tracked")
-            rep.check_invariants()
+            try:
+                # every parked handoff must belong to a prefill-role
+                # replica the router still tracks — an untracked parked
+                # request can never be adopted and would pin its slot
+                # forever
+                for r in rep.pending_handoffs():
+                    if self.roles[i] != "prefill":
+                        raise AssertionError(
+                            f"replica {i} (role {self.roles[i]}) holds "
+                            f"parked handoff {r.request_id}")
+                    if self._tracked.get(r.request_id) is not r:
+                        raise AssertionError(
+                            f"parked handoff {r.request_id} on replica "
+                            f"{i} is not router-tracked")
+                rep.check_invariants()
+            except AssertionError as e:
+                # a violated invariant ANYWHERE is a fleet event: dump
+                # every ring, mark the replica that tripped
+                self.fleet.dump("invariant_violation", error=e,
+                                trigger_replica=i)
+                raise
 
     @property
     def recompiles(self) -> int:
@@ -729,6 +1016,7 @@ class ReplicaRouter:
             "prefix_routed": self.prefix_routed,
             "scale_events": len(self.scale_events),
             "fleet": self.fleet_topology(),
+            "journeys": self.journey_summary(),
             "router_metrics": self.registry.snapshot(),
             "per_replica": {i: self.replicas[i].stats()
                             for i in self.alive_replicas},

@@ -43,6 +43,22 @@ DeepSpeed's observability stack, mapped feature-for-feature:
   records that becomes a self-contained post-mortem JSON when the
   engine raises (invariant violation, stall, strict recompile), and a
   live ``srv.debug_dump()`` statusz snapshot.
+* reference ``monitor/`` + flops profiler, fleet edition:
+  :class:`FleetTelemetry` (``telemetry/fleet.py``). Where the
+  reference fans ONE engine's scalars out to its sinks and profiles
+  ONE module tree, the serving fleet needs the transpose — N replicas'
+  registries merged into one Prometheus exposition with
+  ``replica=``/``role=`` labels, per-replica quantile digests merged
+  bucketwise into fleet p50/p99, goodput/burn computed over SUMMED
+  admission windows, and ONE fleet post-mortem aligning every
+  replica's flight-recorder ring on the shared injected clock.
+  Cross-replica request *journeys* (minted by the router, stamped by
+  each home's :class:`TimelineStore`, stitched by
+  ``ReplicaRouter.journey``) play the flops profiler's attribution
+  role at fleet scope: where a latency went, per hop, per replica —
+  exported as a multi-process Perfetto document via
+  :func:`merge_chrome` (one process lane per replica, flow arrows
+  across handoff/transfer/failover boundaries).
 * no reference analogue: :class:`RecompileWatchdog`. XLA recompilation
   is the TPU-specific production hazard (a shape-churned serving step
   silently costs seconds); the watchdog attributes every recompile to
@@ -67,7 +83,7 @@ Serving integration (all knobs on ``ds.init_serving``)::
     srv.publish_telemetry()     # registry -> monitor sinks
 """
 
-from .tracer import Tracer
+from .tracer import Tracer, export_merged, merge_chrome
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .timeline import TimelineStore
 from .watchdog import (RecompileAfterWarmupError, RecompileWatchdog,
@@ -78,9 +94,16 @@ from .costs import (ProgramCostModel, device_memory_report,
 from .slo import (QuantileDigest, SLOConfig, SLOTargets, SLOTracker,
                   WindowedQuantiles)
 from .flight_recorder import FlightRecorder, POST_MORTEM_KEYS
+from .fleet import (FleetTelemetry, FLEET_POST_MORTEM_KEYS,
+                    FLEET_SCHEMA_VERSION)
 
 __all__ = [
     "Tracer",
+    "merge_chrome",
+    "export_merged",
+    "FleetTelemetry",
+    "FLEET_POST_MORTEM_KEYS",
+    "FLEET_SCHEMA_VERSION",
     "MetricsRegistry",
     "Counter",
     "Gauge",

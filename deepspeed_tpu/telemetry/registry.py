@@ -145,6 +145,14 @@ class MetricsRegistry:
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
         return self._get(name, Histogram, buckets)
 
+    def metrics(self) -> List[object]:
+        """Metric objects after running collectors — the raw view the
+        fleet aggregator labels per replica instead of re-summing the
+        flattened :meth:`snapshot`."""
+        self._run_collectors()
+        with self._lock:
+            return list(self._metrics.values())
+
     def snapshot(self) -> Dict[str, float]:
         """Flat scalar view (histograms contribute count/sum/p50/p99)."""
         self._run_collectors()

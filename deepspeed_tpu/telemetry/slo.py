@@ -354,6 +354,22 @@ class SLOTracker:
         """Sliding-window goodput: finished-within-SLO ÷ admitted."""
         return self._goodput_of(self._gw)
 
+    def window_counts(self) -> Dict[str, List[List[int]]]:
+        """Raw ``[admitted, good]`` window pairs for fleet merging.
+
+        ``short`` is the two-window burn horizon (current + previous),
+        ``all`` the full ring. A fleet aggregator sums the pairs
+        ACROSS replicas and runs the same :meth:`_burn` formula on the
+        merged counts — mathematically identical to one tracker having
+        observed every request, which averaging per-replica burn rates
+        is not (replicas with 2 requests would weigh as much as ones
+        with 2000)."""
+        prev_i = (self._gw_cur - 1) % self.config.windows
+        return {
+            "short": [list(self._gw[self._gw_cur]), list(self._gw[prev_i])],
+            "all": [list(p) for p in self._gw],
+        }
+
     def _burn(self, goodput: float) -> float:
         budget = max(1e-9, 1.0 - self.config.goodput_target)
         return max(0.0, 1.0 - goodput) / budget

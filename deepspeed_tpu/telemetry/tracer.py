@@ -21,6 +21,15 @@ Event kinds emitted (Chrome trace-event ``ph`` codes):
   ``async_instant`` / ``async_end``).
 * ``s``/``f`` — flow start/finish (``flow()``), drawing arrows from a
   request's track into the engine-step spans that serviced it.
+
+Fleet export: :func:`merge_chrome` renders SEVERAL tracers into one
+Chrome/Perfetto document — one *process* lane per tracer (pid = fleet
+position, ``process_name`` metadata from the label), all timestamps
+normalized to the fleet-wide earliest event. Because the tracers share
+one host ``perf_counter_ns`` clock, cross-replica ordering is exact,
+and a flow pair emitted on two different tracers with the same
+``(cat, id)`` renders as an arrow ACROSS process lanes — the journey
+arrows the router draws at every handoff/transfer/failover boundary.
 """
 
 from __future__ import annotations
@@ -29,7 +38,7 @@ import functools
 import json
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
 class _NullSpan:
@@ -252,3 +261,68 @@ class Tracer:
         with open(path, "w") as f:
             json.dump(trace, f)
         return len(trace["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# multi-process (fleet) merge
+# ---------------------------------------------------------------------------
+def merge_chrome(tracers: Sequence[Tuple[str, "Tracer"]]) -> Dict[str, Any]:
+    """Merge several tracers into ONE Chrome trace-event document.
+
+    ``tracers`` is an ordered ``(label, tracer)`` sequence; position in
+    the sequence becomes the Perfetto *pid* and ``label`` its
+    ``process_name`` — a DP fleet renders as one lane per replica (plus
+    the router's own lane). Timestamps are normalized to the earliest
+    event ACROSS the whole fleet: every tracer reads the same
+    process-wide ``perf_counter_ns`` clock, so relative ordering
+    between lanes is exact, and a flow ``s``/``f`` pair whose halves
+    were recorded on two different tracers (same ``cat`` + ``id``)
+    draws its arrow across the process boundary — how a request's
+    handoff/transfer/failover hops stay visually connected.
+    """
+    snap = [(str(label), tr.events()) for label, tr in tracers]
+    base = min((e["ts"] for _, evs in snap for e in evs), default=0)
+    out: List[Dict[str, Any]] = []
+    meta: List[Dict[str, Any]] = []
+    events_total = 0
+    dropped = 0
+    for pid, ((label, evs), (_, tr)) in enumerate(zip(snap, tracers)):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": label}})
+        tids: Dict[int, int] = {}
+        for ev in evs:
+            tid = tids.setdefault(ev.get("tid", 0), len(tids))
+            o = {"name": ev["name"], "ph": ev["ph"], "pid": pid,
+                 "tid": tid, "ts": (ev["ts"] - base) / 1e3}
+            if "dur" in ev:
+                o["dur"] = ev["dur"] / 1e3
+            for k in ("cat", "id", "s", "bp"):
+                if k in ev:
+                    o[k] = ev[k]
+            if ev.get("args"):
+                o["args"] = ev["args"]
+            out.append(o)
+        for tid in tids.values():
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": f"host-{tid}"}})
+        events_total += tr.events_total
+        dropped += tr.dropped
+    return {
+        "traceEvents": meta + out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "processes": {str(i): label
+                          for i, (label, _) in enumerate(snap)},
+            "events_total": events_total,
+            "dropped": dropped,
+        },
+    }
+
+
+def export_merged(path: str,
+                  tracers: Sequence[Tuple[str, "Tracer"]]) -> int:
+    """Write a :func:`merge_chrome` fleet trace; returns event count."""
+    trace = merge_chrome(tracers)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return len(trace["traceEvents"])

@@ -418,4 +418,14 @@ class TestFleetFrontend:
         text = mbody.decode("utf-8")
         assert "router_fleet_size 2" in text
         assert "router_transfers_total" in text
+        # fleet observability plane: /healthz carries the per-replica /
+        # per-role summary, /metrics the merged labeled exposition
+        fh = info["fleet_health"]
+        assert set(fh["replicas"]) == {"0", "1"}
+        assert fh["replicas"]["0"]["role"] == "prefill"
+        assert set(fh["roles"]) == {"prefill", "decode"}
+        assert fh["journeys"]["complete"] == fh["journeys"]["finished"]
+        assert 'replica="0",role="prefill"' in text
+        assert "fleet_goodput" in text
+        assert "fleet_journeys_complete" in text
         router.check_invariants()

@@ -232,6 +232,72 @@ class TestCheckRegression:
         assert _run(base, cand, "--require-zero-leaks").returncode == 2
 
 
+class TestJourneyGate:
+    @staticmethod
+    def _disagg(value=1.0, finished=6, complete=6, overhead=0.5):
+        return {"value": value, "detail": {
+            "journeys": {"total": finished, "finished": finished,
+                         "complete": complete, "incomplete": []},
+            "efficiency": {"goodput_slo": 1.0,
+                           "overhead_pct": overhead}}}
+
+    def test_complete_journeys_pass(self, tmp_path):
+        base = _write(tmp_path, "base.json", self._disagg())
+        cand = _write(tmp_path, "cand.json", self._disagg())
+        r = _run(base, cand, "--require-complete-journeys")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "journeys" in r.stdout
+
+    def test_incomplete_journey_fails_even_with_value_improved(
+            self, tmp_path):
+        # absolute gate: one journey that finished but does not stitch
+        # (an open or parked home) fails regardless of the headline
+        base = _write(tmp_path, "base.json", self._disagg(value=1.0))
+        cand = _write(tmp_path, "cand.json",
+                      self._disagg(value=2.0, complete=5))
+        r = _run(base, cand, "--require-complete-journeys")
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout
+
+    def test_missing_journeys_block_exits_2(self, tmp_path):
+        # a bench that silently stopped emitting detail.journeys is a
+        # broken invocation, not a pass
+        base = _write(tmp_path, "base.json", self._disagg())
+        cand = _write(tmp_path, "cand.json", {"value": 1.0})
+        r = _run(base, cand, "--require-complete-journeys")
+        assert r.returncode == 2
+        assert "journeys" in r.stderr
+
+    def test_malformed_journeys_detail_exits_2(self, tmp_path):
+        # "6"-the-string (or a bool) must not compare as a count
+        base = _write(tmp_path, "base.json", self._disagg())
+        bad = self._disagg()
+        bad["detail"]["journeys"]["complete"] = "6"
+        cand = _write(tmp_path, "cand.json", bad)
+        assert _run(base, cand,
+                    "--require-complete-journeys").returncode == 2
+
+    def test_disagg_gate_combination(self, tmp_path):
+        # the serving-disagg driver invocation stacks the overhead cap,
+        # the journey gate and the recompile cap
+        def row(complete=6, overhead=0.5, recompiles=0):
+            d = self._disagg(complete=complete, overhead=overhead)
+            d["detail"]["recompiles_after_warmup"] = recompiles
+            return d
+
+        gates = ("--max-overhead-pct", "3",
+                 "--require-complete-journeys", "--max-recompiles", "0")
+        base = _write(tmp_path, "base.json", row())
+        assert _run(base, _write(tmp_path, "ok.json", row()),
+                    *gates).returncode == 0
+        assert _run(base, _write(tmp_path, "j.json", row(complete=4)),
+                    *gates).returncode == 1
+        assert _run(base, _write(tmp_path, "o.json", row(overhead=7.5)),
+                    *gates).returncode == 1
+        assert _run(base, _write(tmp_path, "r.json", row(recompiles=1)),
+                    *gates).returncode == 1
+
+
 class TestEfficiencyGates:
     @staticmethod
     def _eff(goodput=1.0, overhead=1.0, mfu=0.3):
@@ -436,6 +502,18 @@ class TestBenchEntryPoints:
                     *gates).returncode == 1
         assert _run(base, _write(tmp_path, "rc.json", row(recompiles=2)),
                     *gates).returncode == 1
+
+    def test_serving_disagg_fleet_detail_wired(self):
+        # the disagg row must emit every field its fleet-observability
+        # gate invocation (--max-overhead-pct 3
+        # --require-complete-journeys --max-recompiles 0) reads
+        src = (REPO / "bench.py").read_text()
+        assert "def serving_disagg_main" in src
+        for key in ("journey_summary", "transfer_latency_p99_ms",
+                    "efficiency_snapshot", "overhead_pct",
+                    "require-complete-journeys",
+                    "reset_efficiency_window"):
+            assert key in src, key
 
     def test_check_regression_importable(self):
         # the module must import without side effects (argparse only
